@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Benchmark: FM train-step throughput on a Criteo-like workload.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N}
+
+Baseline: the driver target of 2M examples/sec aggregate on a v5e-16
+(BASELINE.md) = 125k examples/sec/chip; ``vs_baseline`` is the per-chip
+ratio vs that target, scaled by the number of chips actually used.
+
+Workload: 2nd-order FM, batch 16384, 39 features/example (Criteo layout),
+factor_num 8, vocab 2^22 hash buckets — full train step (forward, backward,
+Adagrad update, metrics) with device-resident batches, steady-state timed.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PER_CHIP_TARGET = 2_000_000 / 16  # BASELINE.md: 2M ex/s on v5e-16
+
+
+def main() -> int:
+    import jax
+
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.data.libsvm import Batch
+    from fast_tffm_tpu.parallel import mesh as mesh_lib
+    from fast_tffm_tpu.train.loop import Trainer
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    platform = devices[0].platform
+
+    cfg = FmConfig(
+        vocabulary_size=1 << 22,
+        factor_num=8,
+        max_features=39,
+        batch_size=16384 * max(1, n_chips),
+        learning_rate=0.05,
+        model_file="/tmp/fast_tffm_tpu_bench_model",
+        log_steps=0,
+    )
+    import shutil
+
+    shutil.rmtree(cfg.model_file, ignore_errors=True)
+    trainer = Trainer(cfg)
+
+    rng = np.random.default_rng(0)
+    n_batches = 4  # rotate a few so no cross-step result reuse
+    batches = []
+    for _ in range(n_batches):
+        b = Batch(
+            labels=rng.integers(0, 2, size=(cfg.batch_size,)).astype(np.float32),
+            ids=rng.integers(0, cfg.vocabulary_size,
+                             size=(cfg.batch_size, cfg.max_features)).astype(np.int32),
+            vals=rng.uniform(0.1, 1.0,
+                             size=(cfg.batch_size, cfg.max_features)).astype(np.float32),
+            fields=np.zeros((cfg.batch_size, cfg.max_features), np.int32),
+            weights=np.ones((cfg.batch_size,), np.float32),
+        )
+        batches.append(trainer._put(b))
+
+    # Warmup: compile + a few steps.
+    for i in range(3):
+        trainer.state = trainer._train_step(trainer.state, batches[i % n_batches])
+    jax.block_until_ready(trainer.state)
+
+    steps = 30
+    t0 = time.perf_counter()
+    for i in range(steps):
+        trainer.state = trainer._train_step(trainer.state, batches[i % n_batches])
+    jax.block_until_ready(trainer.state)
+    dt = time.perf_counter() - t0
+
+    ex_per_sec = steps * cfg.batch_size / dt
+    per_chip = ex_per_sec / n_chips
+    result = {
+        "metric": f"fm_train_examples_per_sec ({platform} x{n_chips}, "
+                  f"B={cfg.batch_size}, F=39, k=8, vocab=2^22)",
+        "value": round(ex_per_sec, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(per_chip / PER_CHIP_TARGET, 4),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

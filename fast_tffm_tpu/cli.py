@@ -1,0 +1,130 @@
+"""CLI: ``run_tffm.py {train|predict} <cfg>`` (reference surface, SURVEY.md §2 #12).
+
+Local mode mirrors the reference exactly.  Distributed mode replaces the
+parameter-server flags with a JAX multi-host launch: every process runs the
+same command with ``--coordinator/--num_processes/--process_id`` and GSPMD
+shards one global training step over all chips (SURVEY.md §2 #10 — the PS
+runtime is subsumed by jit+sharding).
+
+The reference's ``--ps_hosts/--worker_hosts/--job_name/--task_index`` flags
+are still accepted so old launch scripts keep working: worker tasks map to
+JAX processes; ps tasks exit immediately (there are no parameter servers —
+the table is row-sharded across the same chips doing compute).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+log = logging.getLogger("fast_tffm_tpu")
+
+
+def _setup_logging(log_file: str | None):
+    handlers = [logging.StreamHandler(sys.stderr)]
+    if log_file:
+        handlers.append(logging.FileHandler(log_file))
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        handlers=handlers,
+        force=True,
+    )
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="run_tffm",
+        description="TPU-native factorization machine trainer",
+    )
+    p.add_argument("mode", choices=["train", "predict"])
+    p.add_argument("cfg", help="INI config file (reference-compatible)")
+    # TPU-native distributed flags.
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 (multi-host)")
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    # Legacy reference flags (mapped, SURVEY.md §3.2).
+    p.add_argument("--ps_hosts", default=None, help="legacy; ps tasks exit")
+    p.add_argument("--worker_hosts", default=None,
+                   help="legacy; maps to --num_processes")
+    p.add_argument("--job_name", default=None, choices=[None, "ps", "worker"])
+    p.add_argument("--task_index", type=int, default=None,
+                   help="legacy; maps to --process_id")
+    return p
+
+
+def _resolve_dist(args) -> tuple[str, int, int] | None:
+    """Map new+legacy flags to (coordinator, num_processes, process_id)."""
+    if args.job_name == "ps":
+        log.warning(
+            "parameter-server tasks are obsolete: the table is row-sharded "
+            "across compute chips (GSPMD). This ps task exits; remove ps "
+            "entries from your launch scripts."
+        )
+        sys.exit(0)
+    if args.coordinator is not None:
+        if args.num_processes is None or args.process_id is None:
+            raise SystemExit(
+                "--coordinator requires --num_processes and --process_id"
+            )
+        return args.coordinator, args.num_processes, args.process_id
+    if args.worker_hosts is not None:
+        workers = [h for h in args.worker_hosts.split(",") if h]
+        task = args.task_index or 0
+        coordinator = workers[0]
+        log.warning(
+            "legacy --worker_hosts mapped to JAX multi-host: coordinator=%s "
+            "num_processes=%d process_id=%d",
+            coordinator, len(workers), task,
+        )
+        return coordinator, len(workers), task
+    return None
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    from fast_tffm_tpu.config import load_config
+
+    cfg = load_config(args.cfg)
+    _setup_logging(cfg.log_file or None)
+    dist = _resolve_dist(args)
+    if dist is not None:
+        import jax
+
+        coordinator, nproc, pid = dist
+        log.info(
+            "initializing jax.distributed: %s (%d processes, this is %d)",
+            coordinator, nproc, pid,
+        )
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=nproc,
+            process_id=pid,
+        )
+
+    from fast_tffm_tpu.train.loop import Trainer, predict
+
+    if args.mode == "train":
+        result = Trainer(cfg).train()
+        m = result.get("validation", result["train"])
+        log.info("done: %s", result)
+        loss_name = "mse" if cfg.loss_type == "mse" else "logloss"
+        print(
+            f"train {loss_name}={result['train']['loss']:.6f} "
+            f"auc={result['train']['auc']:.4f} "
+            f"ex/s={result['train']['examples_per_sec']:.0f}"
+        )
+        if "validation" in result:
+            print(
+                f"validation {loss_name}={m['loss']:.6f} auc={m['auc']:.4f}"
+            )
+    else:
+        n = predict(cfg)
+        print(f"wrote {n} scores to {cfg.score_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Typed configuration, loadable from the reference's INI ``.cfg`` surface.
+
+The reference drives everything from an INI file with ``[General]``,
+``[Train]`` and ``[Predict]`` sections (SURVEY.md §2 #12, §5 "Config").  We
+accept the same sections and keys, backed by a dataclass, plus TPU-specific
+keys in an optional ``[Tpu]`` section.  Unknown keys warn instead of failing
+so old configs keep working.
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+import glob as _glob
+import logging
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off"}
+
+
+def _parse_bool(s: str) -> bool:
+    v = s.strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    raise ValueError(f"not a boolean: {s!r}")
+
+
+def _parse_files(s: str) -> list[str]:
+    """Comma/semicolon-separated list of file patterns, glob-expanded."""
+    out: list[str] = []
+    for part in s.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        hits = sorted(_glob.glob(part))
+        out.extend(hits if hits else [part])
+    return out
+
+
+@dataclasses.dataclass
+class FmConfig:
+    # --- [General] (reference keys, SURVEY.md §2 #12) ---
+    vocabulary_size: int = 2**20
+    # Kept for config compatibility: the reference used it to split the table
+    # into N variables for parameter servers.  Here sharding is mesh-driven;
+    # the value is accepted and ignored (mesh_model plays its role).
+    vocabulary_block_num: int = 1
+    hash_feature_id: bool = False
+    factor_num: int = 8
+    model_file: str = "./fm_model"
+    log_file: str = ""
+    # Field-aware FM extension: number of fields (0 = plain FM).
+    field_num: int = 0
+
+    # --- [Train] ---
+    train_files: list[str] = dataclasses.field(default_factory=list)
+    weight_files: list[str] = dataclasses.field(default_factory=list)
+    validation_files: list[str] = dataclasses.field(default_factory=list)
+    epoch_num: int = 1
+    batch_size: int = 1024
+    learning_rate: float = 0.01
+    adagrad_initial_accumulator: float = 0.1
+    optimizer: str = "adagrad"  # adagrad | ftrl | sgd | adam
+    loss_type: str = "logistic"  # logistic | mse
+    factor_lambda: float = 0.0
+    bias_lambda: float = 0.0
+    # FTRL extras
+    ftrl_l1: float = 0.0
+    ftrl_l2: float = 0.0
+    ftrl_beta: float = 1.0
+    init_value_range: float = 0.01
+    # Input-pipeline knobs (reference queue knobs, SURVEY.md §2 #6).
+    thread_num: int = 4
+    queue_size: int = 64
+    shuffle_threads: int = 1
+    shuffle_buffer: int = 10000
+    save_steps: int = 0  # 0 = only at end of training
+    log_steps: int = 100
+    seed: int = 0
+
+    # --- [Predict] ---
+    predict_files: list[str] = dataclasses.field(default_factory=list)
+    score_path: str = "./scores.txt"
+
+    # --- [Tpu] (new; not in reference) ---
+    # Max features per example; batches are padded to this static shape.
+    max_features: int = 64
+    # Mesh axes: data-parallel x model-parallel (table row-sharding).
+    mesh_data: int = 1
+    mesh_model: int = 1
+    # Sharded-lookup strategy: "auto" (GSPMD decides from shardings) or
+    # "shardmap" (explicit mod-sharded lookup + psum, SURVEY.md §7 step 4).
+    lookup: str = "auto"
+    # Compute dtype for the interaction term ("float32" | "bfloat16").
+    compute_dtype: str = "float32"
+    # Use the Pallas kernel for the scorer when on TPU.
+    use_pallas: bool = True
+    # L2 mode: "batch" regularizes only the rows touched by the batch
+    # (sparse-friendly); "full" regularizes the whole table (dense grads,
+    # only sane for small vocabularies).
+    l2_mode: str = "batch"
+
+    def __post_init__(self) -> None:
+        if self.vocabulary_size <= 0:
+            raise ValueError("vocabulary_size must be positive")
+        if self.factor_num <= 0:
+            raise ValueError("factor_num must be positive")
+        if self.optimizer not in ("adagrad", "ftrl", "sgd", "adam"):
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if self.loss_type not in ("logistic", "mse"):
+            raise ValueError(f"unknown loss_type {self.loss_type!r}")
+        if self.lookup not in ("auto", "shardmap"):
+            raise ValueError(f"unknown lookup {self.lookup!r}")
+        if self.l2_mode not in ("batch", "full"):
+            raise ValueError(f"unknown l2_mode {self.l2_mode!r}")
+        if self.weight_files and len(self.weight_files) != len(self.train_files):
+            raise ValueError(
+                "weight_files must parallel train_files "
+                f"({len(self.weight_files)} vs {len(self.train_files)})"
+            )
+
+    @property
+    def embedding_dim(self) -> int:
+        """Width of one table row: 1 linear weight + factor vector(s)."""
+        k = self.factor_num
+        return 1 + (k * self.field_num if self.field_num else k)
+
+
+# INI key -> (dataclass field, parser).  Keys match the reference cfg surface
+# (SURVEY.md §2 #12); dotted keys like ``adagrad.initial_accumulator`` are the
+# reference spelling.
+_KEYMAP = {
+    "vocabulary_size": ("vocabulary_size", int),
+    "vocabulary_block_num": ("vocabulary_block_num", int),
+    "hash_feature_id": ("hash_feature_id", _parse_bool),
+    "factor_num": ("factor_num", int),
+    "field_num": ("field_num", int),
+    "model_file": ("model_file", str),
+    "log_file": ("log_file", str),
+    "train_files": ("train_files", _parse_files),
+    "weight_files": ("weight_files", _parse_files),
+    "validation_files": ("validation_files", _parse_files),
+    "epoch_num": ("epoch_num", int),
+    "batch_size": ("batch_size", int),
+    "learning_rate": ("learning_rate", float),
+    "adagrad.initial_accumulator": ("adagrad_initial_accumulator", float),
+    "adagrad_initial_accumulator": ("adagrad_initial_accumulator", float),
+    "optimizer": ("optimizer", str),
+    "loss_type": ("loss_type", str),
+    "factor_lambda": ("factor_lambda", float),
+    "bias_lambda": ("bias_lambda", float),
+    "ftrl.l1": ("ftrl_l1", float),
+    "ftrl.l2": ("ftrl_l2", float),
+    "ftrl.beta": ("ftrl_beta", float),
+    "ftrl_l1": ("ftrl_l1", float),
+    "ftrl_l2": ("ftrl_l2", float),
+    "ftrl_beta": ("ftrl_beta", float),
+    "init_value_range": ("init_value_range", float),
+    "thread_num": ("thread_num", int),
+    "queue_size": ("queue_size", int),
+    "shuffle_threads": ("shuffle_threads", int),
+    "shuffle_buffer": ("shuffle_buffer", int),
+    "save_steps": ("save_steps", int),
+    "log_steps": ("log_steps", int),
+    "seed": ("seed", int),
+    "predict_files": ("predict_files", _parse_files),
+    "score_path": ("score_path", str),
+    "max_features": ("max_features", int),
+    "mesh_data": ("mesh_data", int),
+    "mesh_model": ("mesh_model", int),
+    "lookup": ("lookup", str),
+    "compute_dtype": ("compute_dtype", str),
+    "use_pallas": ("use_pallas", _parse_bool),
+    "l2_mode": ("l2_mode", str),
+}
+
+
+def load_config(path: str, overrides: Optional[dict] = None) -> FmConfig:
+    """Load an INI ``.cfg`` file (reference-compatible) into an FmConfig."""
+    parser = configparser.ConfigParser()
+    read = parser.read(path)
+    if not read:
+        raise FileNotFoundError(path)
+    values: dict = {}
+    for section in parser.sections():
+        for key, raw in parser.items(section):
+            key = key.strip().lower()
+            if key not in _KEYMAP:
+                log.warning("ignoring unknown config key [%s] %s", section, key)
+                continue
+            field, fn = _KEYMAP[key]
+            values[field] = fn(raw)
+    if overrides:
+        values.update(overrides)
+    return FmConfig(**values)

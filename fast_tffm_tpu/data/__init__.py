@@ -1,0 +1,7 @@
+from fast_tffm_tpu.data.libsvm import (  # noqa: F401
+    Batch,
+    make_batch,
+    murmur64,
+    parse_line,
+    parse_lines,
+)

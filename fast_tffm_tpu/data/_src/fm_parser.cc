@@ -1,0 +1,244 @@
+// Native libsvm/ffm batch parser — the TPU-framework equivalent of the
+// reference's multi-threaded C++ `FmParser` TF op (SURVEY.md §2 #1).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).  The
+// Python oracle is fast_tffm_tpu/data/libsvm.py; tests enforce bit-exact
+// agreement (same MurmurHash64A, same label/field/id/val semantics).
+//
+// Threading model: the caller hands one contiguous text buffer plus line
+// offsets; lines are split evenly across worker threads, each writing its
+// own disjoint rows of the output arrays — no locks in the hot path.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -pthread fm_parser.cc -o libfm_parser.so
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+#include <atomic>
+
+namespace {
+
+constexpr uint64_t kMurmurM = 0xc6a4a7935bd1e995ULL;
+constexpr int kMurmurR = 47;
+
+// MurmurHash64A, seed 0 — must match libsvm.murmur64 bit-for-bit.
+uint64_t Murmur64(const char* data, size_t len) {
+  uint64_t h = 0 ^ (static_cast<uint64_t>(len) * kMurmurM);
+  const size_t n_blocks = len / 8;
+  for (size_t i = 0; i < n_blocks; ++i) {
+    uint64_t k;
+    std::memcpy(&k, data + i * 8, 8);  // little-endian hosts only (x86/ARM)
+    k *= kMurmurM;
+    k ^= k >> kMurmurR;
+    k *= kMurmurM;
+    h ^= k;
+    h *= kMurmurM;
+  }
+  const size_t tail_len = len & 7;
+  if (tail_len) {
+    uint64_t t = 0;
+    std::memcpy(&t, data + n_blocks * 8, tail_len);
+    h ^= t;
+    h *= kMurmurM;
+  }
+  h ^= h >> kMurmurR;
+  h *= kMurmurM;
+  h ^= h >> kMurmurR;
+  return h;
+}
+
+inline bool IsSpace(char c) {
+  return c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\v' ||
+         c == '\f';
+}
+
+struct Parser {
+  uint64_t vocabulary_size;
+  int max_features;
+  bool hash_feature_id;
+  int field_num;
+  int num_threads;
+};
+
+// Python-compatible modulo (result always in [0, m)).
+inline int64_t PyMod(int64_t x, int64_t m) {
+  int64_t r = x % m;
+  return r < 0 ? r + m : r;
+}
+
+// Parses one line into row `row` of the outputs. Returns the number of
+// feature tokens dropped by max_features truncation; -1 on malformed input.
+int ParseLine(const Parser& p, const char* s, const char* end, int64_t row,
+              float* labels, int32_t* ids, float* vals, int32_t* fields) {
+  // Trim.
+  while (s < end && IsSpace(*s)) ++s;
+  while (end > s && IsSpace(end[-1])) --end;
+  if (s >= end || *s == '#') return 0;  // blank/comment: row stays zeroed
+
+  char* next = nullptr;
+  float label = std::strtof(s, &next);
+  // The label token must be fully consumed ("1x" is malformed, like
+  // Python float("1x")).
+  if (next == s || (next != end && !IsSpace(*next))) return -1;
+  if (label == -1.0f) label = 0.0f;  // accept {-1,1} label convention
+  labels[row] = label;
+
+  const char* cur = next;
+  int count = 0;
+  int dropped = 0;
+  int32_t* row_ids = ids + row * p.max_features;
+  float* row_vals = vals + row * p.max_features;
+  int32_t* row_fields = fields + row * p.max_features;
+
+  while (cur < end) {
+    while (cur < end && IsSpace(*cur)) ++cur;
+    if (cur >= end) break;
+    const char* tok = cur;
+    while (cur < end && !IsSpace(*cur)) ++cur;
+    const char* tok_end = cur;
+
+    // Split token on ':' — up to 3 pieces: [field:]id[:val]
+    const char* c1 = nullptr;
+    const char* c2 = nullptr;
+    for (const char* q = tok; q < tok_end; ++q) {
+      if (*q == ':') {
+        if (!c1) {
+          c1 = q;
+        } else if (!c2) {
+          c2 = q;
+        } else {
+          return -1;  // too many colons
+        }
+      }
+    }
+    const char *id_s, *id_e;
+    const char *val_s = nullptr, *val_e = nullptr;
+    int64_t field = 0;
+    if (c2) {  // field:id:val
+      char* fend = nullptr;
+      field = std::strtoll(tok, &fend, 10);
+      if (tok == c1 || fend != c1) return -1;  // empty/partial field
+      id_s = c1 + 1;
+      id_e = c2;
+      val_s = c2 + 1;
+      val_e = tok_end;
+    } else if (c1) {  // id:val
+      id_s = tok;
+      id_e = c1;
+      val_s = c1 + 1;
+      val_e = tok_end;
+    } else {  // bare id => val 1.0
+      id_s = tok;
+      id_e = tok_end;
+    }
+
+    // Validate BEFORE the truncation check so a malformed over-limit token
+    // errors exactly like the Python oracle (which parses, then truncates).
+    int64_t fid;
+    if (p.hash_feature_id) {
+      fid = static_cast<int64_t>(Murmur64(id_s, id_e - id_s) %
+                                 p.vocabulary_size);
+    } else {
+      char* iend = nullptr;
+      int64_t raw = std::strtoll(id_s, &iend, 10);
+      // int("") raises in Python: require a nonempty, fully-consumed id.
+      if (id_s == id_e || iend != id_e) return -1;
+      fid = PyMod(raw, static_cast<int64_t>(p.vocabulary_size));
+    }
+    float v = 1.0f;
+    if (val_s) {
+      char* vend = nullptr;
+      v = std::strtof(val_s, &vend);
+      if (val_s == val_e || vend != val_e) return -1;  // float("") raises
+    }
+    if (p.field_num > 0) field = PyMod(field, p.field_num);
+
+    if (count >= p.max_features) {
+      ++dropped;
+      continue;
+    }
+    row_ids[count] = static_cast<int32_t>(fid);
+    row_vals[count] = v;
+    row_fields[count] = static_cast<int32_t>(field);
+    ++count;
+  }
+  return dropped;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fm_parser_create(uint64_t vocabulary_size, int max_features,
+                       int hash_feature_id, int field_num, int num_threads) {
+  Parser* p = new Parser();
+  p->vocabulary_size = vocabulary_size;
+  p->max_features = max_features;
+  p->hash_feature_id = hash_feature_id != 0;
+  p->field_num = field_num;
+  p->num_threads = num_threads < 1 ? 1 : num_threads;
+  return p;
+}
+
+void fm_parser_destroy(void* handle) { delete static_cast<Parser*>(handle); }
+
+// Parse n_lines lines (buf + offsets, offsets has n_lines+1 entries) into
+// the first n_lines rows of the [batch_size, max_features] outputs.  All
+// output arrays must be pre-zeroed by the caller (padding convention).
+// weights_in may be null (-> 1.0 for parsed rows).  Returns total dropped
+// (truncated) feature count, or -1 if any line was malformed.
+int64_t fm_parser_parse(void* handle, const char* buf,
+                        const int64_t* offsets, int64_t n_lines,
+                        float* labels, int32_t* ids, float* vals,
+                        int32_t* fields, float* weights,
+                        const float* weights_in) {
+  const Parser& p = *static_cast<Parser*>(handle);
+  std::atomic<int64_t> dropped{0};
+  // First malformed line index, or INT64_MAX if none (min across threads).
+  std::atomic<int64_t> first_bad{INT64_MAX};
+
+  auto work = [&](int64_t begin, int64_t stop) {
+    int64_t local_dropped = 0;
+    for (int64_t i = begin; i < stop; ++i) {
+      int d = ParseLine(p, buf + offsets[i], buf + offsets[i + 1], i, labels,
+                        ids, vals, fields);
+      if (d < 0) {
+        int64_t cur = first_bad.load(std::memory_order_relaxed);
+        while (i < cur &&
+               !first_bad.compare_exchange_weak(cur, i,
+                                                std::memory_order_relaxed)) {
+        }
+        return;
+      }
+      local_dropped += d;
+      weights[i] = weights_in ? weights_in[i] : 1.0f;
+    }
+    dropped.fetch_add(local_dropped, std::memory_order_relaxed);
+  };
+
+  int nt = p.num_threads;
+  if (nt <= 1 || n_lines < 2 * nt) {
+    work(0, n_lines);
+  } else {
+    std::vector<std::thread> threads;
+    int64_t chunk = (n_lines + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      int64_t b = t * chunk;
+      int64_t e = b + chunk < n_lines ? b + chunk : n_lines;
+      if (b >= e) break;
+      threads.emplace_back(work, b, e);
+    }
+    for (auto& th : threads) th.join();
+  }
+  int64_t bad = first_bad.load();
+  if (bad != INT64_MAX) return -(bad + 1);  // -(line_index + 1)
+  return dropped.load();
+}
+
+uint64_t fm_parser_murmur64(const char* data, int64_t len) {
+  return Murmur64(data, len);
+}
+
+}  // extern "C"

@@ -1,0 +1,165 @@
+"""libsvm/ffm text parsing — pure-Python oracle for the C++ parser.
+
+The reference parses batches of libsvm lines (``label feat:val ...``) in a
+multi-threaded C++ TF op (``FmParser``, SURVEY.md §2 #1), optionally hashing
+arbitrary feature-id strings into ``vocabulary_size`` buckets.  This module
+is the bit-exact Python oracle: the C++ extension
+(``fast_tffm_tpu/data/_src/fm_parser.cc``) must agree with it on every line,
+and tests enforce that.
+
+Unlike the reference's ragged tensors, batches here are **padded to a static
+shape** ``[batch, max_features]`` — XLA requires static shapes, and padded
+slots carry ``val == 0`` so they contribute nothing to the FM score or its
+gradient (score terms and grads are all scaled by the feature value).
+
+Supported line formats:
+  - libsvm:  ``label id:val id:val ...``
+  - ffm:     ``label field:id:val ...`` (field-aware FM extension)
+  - ids are integers, or arbitrary strings when ``hash_feature_id`` is on.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+_MASK64 = (1 << 64) - 1
+_M = 0xC6A4A7935BD1E995
+_R = 47
+
+
+def murmur64(data: bytes, seed: int = 0) -> int:
+    """MurmurHash64A — matches the C++ implementation bit-for-bit."""
+    length = len(data)
+    h = (seed ^ ((length * _M) & _MASK64)) & _MASK64
+    n_blocks = length // 8
+    for i in range(n_blocks):
+        k = int.from_bytes(data[i * 8 : i * 8 + 8], "little")
+        k = (k * _M) & _MASK64
+        k ^= k >> _R
+        k = (k * _M) & _MASK64
+        h ^= k
+        h = (h * _M) & _MASK64
+    tail = data[n_blocks * 8 :]
+    if tail:
+        t = int.from_bytes(tail, "little")
+        h ^= t
+        h = (h * _M) & _MASK64
+    h ^= h >> _R
+    h = (h * _M) & _MASK64
+    h ^= h >> _R
+    return h
+
+
+def hash_bucket(token: str, vocabulary_size: int) -> int:
+    return murmur64(token.encode("utf-8")) % vocabulary_size
+
+
+class Batch(NamedTuple):
+    """A fixed-shape parsed batch, ready for the device.
+
+    Padded feature slots have ``vals == 0`` (and ``ids == 0``), which makes
+    them mathematically inert in the FM score and gradient.
+    """
+
+    labels: np.ndarray  # [B] float32, in {0, 1} for logistic loss
+    ids: np.ndarray  # [B, F] int32 bucket ids
+    vals: np.ndarray  # [B, F] float32 feature values (0 = padding)
+    fields: np.ndarray  # [B, F] int32 field ids (all 0 for plain FM)
+    weights: np.ndarray  # [B] float32 per-example weights
+
+
+class Example(NamedTuple):
+    label: float
+    ids: list[int]
+    vals: list[float]
+    fields: list[int]
+
+
+def parse_line(
+    line: str,
+    vocabulary_size: int,
+    hash_feature_id: bool = False,
+    field_num: int = 0,
+) -> Optional[Example]:
+    """Parse one libsvm/ffm line. Returns None for blank/comment lines."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    parts = line.split()
+    label = float(parts[0])
+    # The reference trains logistic loss on CTR labels; accept {-1,1} and
+    # {0,1} conventions by folding -1 to 0.
+    if label == -1.0:
+        label = 0.0
+    ids: list[int] = []
+    vals: list[float] = []
+    fields: list[int] = []
+    for tok in parts[1:]:
+        pieces = tok.split(":")
+        if len(pieces) == 3:
+            field_s, id_s, val_s = pieces
+            field = int(field_s)
+        elif len(pieces) == 2:
+            field = 0
+            id_s, val_s = pieces
+        elif len(pieces) == 1:
+            # Bare feature id => implicit value 1.0 (binary features).
+            field, id_s, val_s = 0, pieces[0], "1"
+        else:
+            raise ValueError(f"malformed feature token {tok!r}")
+        if hash_feature_id:
+            fid = hash_bucket(id_s, vocabulary_size)
+        else:
+            fid = int(id_s) % vocabulary_size
+        if field_num:
+            field = field % field_num
+        ids.append(fid)
+        vals.append(float(val_s))
+        fields.append(field)
+    return Example(label, ids, vals, fields)
+
+
+def parse_lines(
+    lines: Iterable[str],
+    vocabulary_size: int,
+    hash_feature_id: bool = False,
+    field_num: int = 0,
+) -> list[Example]:
+    out = []
+    for line in lines:
+        ex = parse_line(line, vocabulary_size, hash_feature_id, field_num)
+        if ex is not None:
+            out.append(ex)
+    return out
+
+
+def make_batch(
+    examples: Sequence[Example],
+    batch_size: int,
+    max_features: int,
+    weights: Optional[Sequence[float]] = None,
+) -> Batch:
+    """Pad/truncate examples into a static-shape Batch.
+
+    Short batches (end of epoch) are padded with weight-0 examples so the
+    device shapes never change; truncated features beyond ``max_features``
+    are dropped (the C++ parser counts these so callers can warn).
+    """
+    n = len(examples)
+    if n > batch_size:
+        raise ValueError(f"{n} examples > batch_size {batch_size}")
+    labels = np.zeros((batch_size,), np.float32)
+    ids = np.zeros((batch_size, max_features), np.int32)
+    vals = np.zeros((batch_size, max_features), np.float32)
+    fields = np.zeros((batch_size, max_features), np.int32)
+    w = np.zeros((batch_size,), np.float32)
+    for i, ex in enumerate(examples):
+        labels[i] = ex.label
+        k = min(len(ex.ids), max_features)
+        ids[i, :k] = ex.ids[:k]
+        vals[i, :k] = ex.vals[:k]
+        fields[i, :k] = ex.fields[:k]
+        w[i] = 1.0 if weights is None else weights[i]
+    return Batch(labels, ids, vals, fields, w)

@@ -1,0 +1,146 @@
+"""ctypes wrapper around the C++ batch parser (lazy-built with g++).
+
+The shared library is compiled on first use into the package directory and
+cached (rebuilt when the source is newer).  ``NativeParser.parse_batch`` is
+the drop-in fast path for the Python oracle's parse+batch
+(:func:`fast_tffm_tpu.data.libsvm.parse_lines` + ``make_batch``); tests
+enforce bit-exact agreement between the two.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from fast_tffm_tpu.data.libsvm import Batch
+
+log = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "_src")
+_SRC = os.path.join(_SRC_DIR, "fm_parser.cc")
+_LIB = os.path.join(_SRC_DIR, "libfm_parser.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> str:
+    with _build_lock:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+            return _LIB
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            _SRC, "-o", _LIB + ".tmp",
+        ]
+        log.info("building native parser: %s", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(_LIB + ".tmp", _LIB)
+        return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_build())
+    lib.fm_parser_create.restype = ctypes.c_void_p
+    lib.fm_parser_create.argtypes = [
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.fm_parser_destroy.argtypes = [ctypes.c_void_p]
+    lib.fm_parser_parse.restype = ctypes.c_int64
+    lib.fm_parser_parse.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p,
+    ]
+    lib.fm_parser_murmur64.restype = ctypes.c_uint64
+    lib.fm_parser_murmur64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    _lib = lib
+    return lib
+
+
+def murmur64_native(data: bytes) -> int:
+    return _load().fm_parser_murmur64(data, len(data))
+
+
+class NativeParser:
+    """Multi-threaded libsvm batch parser backed by the C++ extension."""
+
+    def __init__(
+        self,
+        vocabulary_size: int,
+        max_features: int,
+        hash_feature_id: bool = False,
+        field_num: int = 0,
+        num_threads: int = 4,
+    ):
+        self._lib = _load()
+        self.max_features = max_features
+        self.truncated_features = 0  # running count, like reference warnings
+        self._handle = self._lib.fm_parser_create(
+            vocabulary_size, max_features, int(hash_feature_id), field_num,
+            num_threads,
+        )
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.fm_parser_destroy(handle)
+            self._handle = None
+
+    def parse_batch(
+        self,
+        lines: Sequence[str],
+        batch_size: int,
+        weights: Optional[Sequence[float]] = None,
+    ) -> Batch:
+        n = len(lines)
+        if n > batch_size:
+            raise ValueError(f"{n} lines > batch_size {batch_size}")
+        encoded = [s.encode("utf-8") for s in lines]
+        buf = b"\n".join(encoded)
+        lens = np.fromiter((len(e) for e in encoded), np.int64, count=n)
+        offsets = np.zeros((n + 1,), np.int64)
+        np.cumsum(lens + 1, out=offsets[1:])  # +1 for the joining '\n'
+        if n:
+            offsets[n] -= 1  # last line has no trailing separator
+
+        labels = np.zeros((batch_size,), np.float32)
+        ids = np.zeros((batch_size, self.max_features), np.int32)
+        vals = np.zeros((batch_size, self.max_features), np.float32)
+        fields = np.zeros((batch_size, self.max_features), np.int32)
+        w = np.zeros((batch_size,), np.float32)
+
+        weights_in = None
+        weights_ptr = None
+        if weights is not None:
+            weights_in = np.ascontiguousarray(weights, np.float32)
+            if weights_in.shape != (n,):
+                raise ValueError("weights must have one entry per line")
+            weights_ptr = weights_in.ctypes.data_as(ctypes.c_void_p)
+
+        dropped = self._lib.fm_parser_parse(
+            self._handle, buf, offsets, n, labels, ids, vals, fields, w,
+            weights_ptr,
+        )
+        if dropped < 0:
+            bad = -int(dropped) - 1
+            raise ValueError(
+                f"malformed libsvm input at batch line {bad}: {lines[bad]!r}"
+            )
+        if dropped:
+            self.truncated_features += int(dropped)
+        return Batch(labels, ids, vals, fields, w)

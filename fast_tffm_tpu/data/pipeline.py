@@ -1,0 +1,251 @@
+"""Input pipeline: files -> shuffled, parsed, padded device batches.
+
+Replaces the reference's TF queue-runner pipeline (``TextLineReader`` +
+shuffle batch queues, SURVEY.md §2 #6) with a thread-based producer/consumer
+design driven by the same config knobs (``thread_num``, ``queue_size``,
+``shuffle_buffer``, ``epoch_num``), feeding numpy batches that the train
+loop ships to the device while the next batch parses — host-side pipelining
+in place of TF queues.
+
+Parsing uses the C++ extension when available (multi-threaded tokenizer +
+murmur hashing, like the reference's ``FmParser``) and falls back to the
+pure-Python oracle.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data import libsvm
+
+log = logging.getLogger(__name__)
+
+_SENTINEL = object()
+
+
+class _Error:
+    """Carries a worker/reader exception to the consuming thread."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _read_weight_file(path: str) -> list[str]:
+    # Keep EVERY line (even blanks) so weight line i pairs with data line i;
+    # parsing to float happens only for lines actually used.
+    with open(path) as f:
+        return [line.strip() for line in f]
+
+
+def iter_lines(
+    files: Sequence[str],
+    weight_files: Optional[Sequence[str]] = None,
+) -> Iterator[tuple[str, float]]:
+    """Yield (line, weight) over all files; weights default to 1.0.
+
+    ``weight_files`` parallels ``files`` line-for-line (reference
+    ``weight_files`` cfg key, SURVEY.md §2 #6): weight-file line i belongs
+    to data-file line i; blank/comment data lines are skipped along with
+    their weight lines.
+    """
+    for i, path in enumerate(files):
+        weights = None
+        if weight_files:
+            weights = _read_weight_file(weight_files[i])
+        with open(path) as f:
+            for lineno, line in enumerate(f):
+                if not line.strip() or line.lstrip().startswith("#"):
+                    continue
+                if weights is None:
+                    w = 1.0
+                else:
+                    try:
+                        w = float(weights[lineno])
+                    except (IndexError, ValueError) as e:
+                        raise ValueError(
+                            f"weight file {weight_files[i]} line {lineno + 1} "
+                            f"does not pair with data file {path}: {e}"
+                        ) from e
+                yield line, w
+
+
+def _shuffled(
+    it: Iterator[tuple[str, float]], buffer_size: int, rng: random.Random
+) -> Iterator[tuple[str, float]]:
+    """Reservoir-style streaming shuffle (like TF's shuffle queue)."""
+    buf: list[tuple[str, float]] = []
+    for item in it:
+        if len(buf) < buffer_size:
+            buf.append(item)
+            continue
+        j = rng.randrange(buffer_size)
+        yield buf[j]
+        buf[j] = item
+    rng.shuffle(buf)
+    yield from buf
+
+
+class BatchPipeline:
+    """Background-threaded parse/batch pipeline.
+
+    One reader thread streams (line, weight) pairs into a work queue in
+    chunks; ``thread_num`` parser threads turn chunks into padded
+    :class:`Batch` objects pushed to a bounded output queue
+    (``queue_size``).  Batch order is nondeterministic across parser
+    threads (like the reference's async queues); set ``thread_num=1`` for
+    determinism.
+    """
+
+    def __init__(
+        self,
+        files: Sequence[str],
+        cfg: FmConfig,
+        *,
+        weight_files: Optional[Sequence[str]] = None,
+        epochs: int = 1,
+        shuffle: bool = True,
+        drop_remainder: bool = False,
+        seed: Optional[int] = None,
+        ordered: bool = False,
+    ):
+        self.files = list(files)
+        self.cfg = cfg
+        self.weight_files = list(weight_files) if weight_files else None
+        self.epochs = epochs
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+        self.seed = cfg.seed if seed is None else seed
+        # ordered=True forces one parser thread so batches come out in
+        # input order (the predict path needs score/line alignment).
+        self.ordered = ordered
+        self._parser = _make_parser(cfg)
+
+    def __iter__(self) -> Iterator[libsvm.Batch]:
+        cfg = self.cfg
+        work: queue.Queue = queue.Queue(maxsize=max(2, cfg.queue_size))
+        out: queue.Queue = queue.Queue(maxsize=max(2, cfg.queue_size))
+        n_workers = 1 if self.ordered else max(1, cfg.thread_num)
+        stop = threading.Event()
+
+        def put_checked(q: queue.Queue, item) -> bool:
+            """Bounded put that gives up once the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def reader():
+            try:
+                for epoch in range(self.epochs):
+                    it = iter_lines(self.files, self.weight_files)
+                    if self.shuffle:
+                        rng = random.Random(self.seed + epoch)
+                        it = _shuffled(it, max(1, cfg.shuffle_buffer), rng)
+                    chunk: list[tuple[str, float]] = []
+                    for item in it:
+                        if stop.is_set():
+                            return
+                        chunk.append(item)
+                        if len(chunk) == cfg.batch_size:
+                            if not put_checked(work, chunk):
+                                return
+                            chunk = []
+                    if chunk and not self.drop_remainder:
+                        put_checked(work, chunk)
+            except BaseException as e:  # surfaces in the consumer
+                put_checked(out, _Error(e))
+            finally:
+                for _ in range(n_workers):
+                    put_checked(work, _SENTINEL)
+
+        def parse_worker():
+            while not stop.is_set():
+                try:
+                    chunk = work.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if chunk is _SENTINEL:
+                    put_checked(out, _SENTINEL)
+                    return
+                try:
+                    lines = [c[0] for c in chunk]
+                    weights = [c[1] for c in chunk]
+                    batch = self._parser(lines, weights)
+                except BaseException as e:
+                    put_checked(out, _Error(e))
+                    continue
+                put_checked(out, batch)
+
+        threads = [threading.Thread(target=reader, daemon=True)]
+        threads += [
+            threading.Thread(target=parse_worker, daemon=True)
+            for _ in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+        finished = 0
+        try:
+            while finished < n_workers:
+                item = out.get()
+                if item is _SENTINEL:
+                    finished += 1
+                    continue
+                if isinstance(item, _Error):
+                    raise item.exc
+                yield item
+        finally:
+            # Unblock and reap every thread: stop flag + drain both queues.
+            stop.set()
+            for t in threads:
+                while t.is_alive():
+                    for q in (work, out):
+                        try:
+                            while True:
+                                q.get_nowait()
+                        except queue.Empty:
+                            pass
+                    t.join(timeout=0.05)
+
+
+def _make_parser(cfg: FmConfig):
+    """Returns lines, weights -> Batch, preferring the C++ parser."""
+    native = None
+    try:
+        from fast_tffm_tpu.data import native as _native
+
+        native = _native.NativeParser(
+            vocabulary_size=cfg.vocabulary_size,
+            max_features=cfg.max_features,
+            hash_feature_id=cfg.hash_feature_id,
+            field_num=cfg.field_num,
+            num_threads=max(1, cfg.thread_num),
+        )
+    except Exception as e:  # pragma: no cover - env-dependent
+        log.info("native parser unavailable (%s); using Python parser", e)
+
+    if native is not None:
+
+        def parse(lines, weights):
+            return native.parse_batch(lines, cfg.batch_size, weights)
+
+        return parse
+
+    def parse_py(lines, weights):
+        examples = libsvm.parse_lines(
+            lines, cfg.vocabulary_size, cfg.hash_feature_id, cfg.field_num
+        )
+        return libsvm.make_batch(
+            examples, cfg.batch_size, cfg.max_features, weights
+        )
+
+    return parse_py

@@ -1,0 +1,6 @@
+from fast_tffm_tpu.models.fm import (  # noqa: F401
+    FmParams,
+    fm_scores,
+    init_params,
+    loss_and_metrics,
+)

@@ -1,0 +1,203 @@
+"""FM / field-aware FM model core — the pure-jnp oracle.
+
+Numeric spec (reference ``FmScorer``, SURVEY.md §3.4):
+
+    score_e = w0 + sum_i w[i]*x_i
+                 + 0.5 * sum_f [ (sum_i V[i,f]*x_i)^2 - sum_i V[i,f]^2*x_i^2 ]
+
+The parameter store is ONE table ``[vocab, D]`` whose column 0 is the linear
+weight and columns 1: the factor vector(s) — mirroring the reference's
+combined bias+factor rows (SURVEY.md §2 #5) and giving a single gather per
+batch.  For field-aware FM (BASELINE config 5) ``D = 1 + field_num*k`` and
+the interaction uses per-field factors ``<v_{i,f_j}, v_{j,f_i}>``.
+
+Everything here is jit-friendly: static shapes, no Python branching on traced
+values.  Padded feature slots carry ``val == 0`` and thus contribute nothing
+to the score or its gradient.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fast_tffm_tpu.config import FmConfig
+
+
+class FmParams(NamedTuple):
+    w0: jax.Array  # [] global bias
+    table: jax.Array  # [vocab, 1 + k] or [vocab, 1 + field_num*k]
+
+
+def init_params(rng: jax.Array, cfg: FmConfig, dtype=jnp.float32) -> FmParams:
+    """Uniform init in ±init_value_range (reference behavior, SURVEY.md §2 #5)."""
+    table = jax.random.uniform(
+        rng,
+        (cfg.vocabulary_size, cfg.embedding_dim),
+        dtype=dtype,
+        minval=-cfg.init_value_range,
+        maxval=cfg.init_value_range,
+    )
+    return FmParams(w0=jnp.zeros((), dtype), table=table)
+
+
+def interaction_terms(
+    rows: jax.Array,  # [B, F, 1+k] gathered table rows
+    vals: jax.Array,  # [B, F]
+    compute_dtype=jnp.float32,
+):
+    """Per-example (linear, s1, s2) partial sums for plain FM.
+
+    These are linear in per-feature contributions, so a row-sharded backend
+    can compute them per shard and psum (SURVEY.md §7 step 4); the final
+    squaring happens in :func:`scores_from_terms` after the reduction.
+    """
+    rows = rows.astype(compute_dtype)
+    vals = vals.astype(compute_dtype)
+    w = rows[..., 0]  # [B, F]
+    v = rows[..., 1:]  # [B, F, k]
+    linear = jnp.sum(w * vals, axis=-1)  # [B]
+    xv = v * vals[..., None]  # [B, F, k]
+    s1 = jnp.sum(xv, axis=1)  # [B, k]
+    s2 = jnp.sum(xv * xv, axis=1)  # [B, k]
+    return linear, s1, s2
+
+
+def scores_from_terms(w0, linear, s1, s2) -> jax.Array:
+    return w0 + linear + 0.5 * jnp.sum(s1 * s1 - s2, axis=-1)
+
+
+def ffm_scores_from_rows(
+    w0: jax.Array,
+    rows: jax.Array,  # [B, F, 1 + field_num*k]
+    vals: jax.Array,  # [B, F]
+    fields: jax.Array,  # [B, F] int32
+    factor_num: int,
+    field_num: int,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Field-aware FM: score = w0 + sum w_i x_i + sum_{i<j} <v_{i,f_j}, v_{j,f_i}> x_i x_j."""
+    rows = rows.astype(compute_dtype)
+    vals = vals.astype(compute_dtype)
+    b, f = vals.shape
+    w = rows[..., 0]
+    v = rows[..., 1:].reshape(b, f, field_num, factor_num)  # [B,F,Fl,k]
+    linear = jnp.sum(w * vals, axis=-1)
+    # v_sel[b, i, j, :] = v[b, i, fields[b, j], :]
+    v_sel = jax.vmap(
+        lambda vb, fb: vb[:, fb, :]  # [F,Fl,k] indexed by [F] -> [F,F,k]
+    )(v, fields)
+    inter_full = jnp.einsum("bijk,bjik->bij", v_sel, v_sel)  # <v_{i,f_j}, v_{j,f_i}>
+    xx = vals[:, :, None] * vals[:, None, :]  # [B,i,j]
+    pair = inter_full * xx
+    # Strict upper triangle: i < j (no self-interactions in FFM).
+    iu = jnp.triu(jnp.ones((f, f), bool), k=1)
+    inter = jnp.sum(jnp.where(iu[None], pair, 0.0), axis=(1, 2))
+    return w0 + linear + inter
+
+
+def fm_scores(
+    params: FmParams,
+    ids: jax.Array,  # [B, F] int32
+    vals: jax.Array,  # [B, F] float32
+    fields: Optional[jax.Array] = None,
+    *,
+    factor_num: int,
+    field_num: int = 0,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Oracle forward: gather + score. One `take` = one gather op for XLA."""
+    rows = params.table[ids]  # [B, F, D]
+    if field_num:
+        assert fields is not None
+        return ffm_scores_from_rows(
+            params.w0, rows, vals, fields, factor_num, field_num, compute_dtype
+        )
+    linear, s1, s2 = interaction_terms(rows, vals, compute_dtype)
+    return scores_from_terms(params.w0.astype(compute_dtype), linear, s1, s2)
+
+
+def example_losses(scores: jax.Array, labels: jax.Array, loss_type: str) -> jax.Array:
+    if loss_type == "logistic":
+        # Numerically stable BCE-with-logits (labels in {0,1}).
+        return jax.nn.softplus(scores) - labels * scores
+    elif loss_type == "mse":
+        d = scores - labels
+        return d * d
+    raise ValueError(f"unknown loss_type {loss_type!r}")
+
+
+def l2_penalty_batch(
+    params: FmParams,
+    rows: jax.Array,  # [B, F, D] the rows this batch touched
+    vals: jax.Array,  # [B, F] (0 marks padding)
+    factor_lambda: float,
+    bias_lambda: float,
+) -> jax.Array:
+    """Sparse-friendly L2: regularize only rows touched by the batch.
+
+    The reference's dense full-table ``tf.nn.l2_loss`` would make every update
+    dense — unaffordable for a row-sharded 1e9-row table — so the default
+    regularizes per occurrence, normalized by batch size.  ``l2_mode=full``
+    in the config selects the exact dense penalty instead.
+    """
+    mask = (vals != 0).astype(rows.dtype)[..., None]  # [B, F, 1]
+    b = vals.shape[0]
+    w_sq = jnp.sum((rows[..., :1] * mask) ** 2)
+    v_sq = jnp.sum((rows[..., 1:] * mask) ** 2)
+    return (factor_lambda * v_sq + bias_lambda * (w_sq + params.w0**2)) / b
+
+
+def l2_penalty_full(
+    params: FmParams, factor_lambda: float, bias_lambda: float
+) -> jax.Array:
+    w_sq = jnp.sum(params.table[:, 0] ** 2)
+    v_sq = jnp.sum(params.table[:, 1:] ** 2)
+    return factor_lambda * v_sq + bias_lambda * (w_sq + params.w0**2)
+
+
+def loss_and_metrics(
+    params: FmParams,
+    labels: jax.Array,
+    ids: jax.Array,
+    vals: jax.Array,
+    fields: Optional[jax.Array],
+    weights: jax.Array,
+    cfg: FmConfig,
+    compute_dtype=jnp.float32,
+):
+    """Weighted training loss (+L2) and unregularized metrics.
+
+    Padded examples carry weight 0 and drop out of both loss and metrics.
+    Returns ``(loss, aux)`` for ``jax.value_and_grad(..., has_aux=True)``.
+    """
+    rows = params.table[ids]
+    if cfg.field_num:
+        scores = ffm_scores_from_rows(
+            params.w0, rows, vals, fields, cfg.factor_num, cfg.field_num, compute_dtype
+        )
+    else:
+        linear, s1, s2 = interaction_terms(rows, vals, compute_dtype)
+        scores = scores_from_terms(params.w0.astype(compute_dtype), linear, s1, s2)
+    per_ex = example_losses(scores, labels.astype(compute_dtype), cfg.loss_type)
+    wsum = jnp.maximum(jnp.sum(weights), 1e-12)
+    data_loss = jnp.sum(per_ex * weights) / wsum
+    if cfg.factor_lambda or cfg.bias_lambda:
+        if cfg.l2_mode == "full":
+            reg = l2_penalty_full(params, cfg.factor_lambda, cfg.bias_lambda)
+        else:
+            reg = l2_penalty_batch(
+                params, rows, vals, cfg.factor_lambda, cfg.bias_lambda
+            )
+    else:
+        reg = jnp.zeros((), compute_dtype)
+    loss = data_loss + reg
+    aux = {
+        "data_loss": data_loss,
+        "reg": reg,
+        "scores": scores,
+        "weight_sum": jnp.sum(weights),
+    }
+    return loss, aux

@@ -1,0 +1,5 @@
+from fast_tffm_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    param_sharding,
+)

@@ -1,0 +1,84 @@
+"""Device mesh + sharding layout — the GSPMD replacement for the PS runtime.
+
+The reference spreads its ``vocabulary_block_num`` table blocks across
+parameter-server tasks and replicates workers (SURVEY.md §2 #5, #10).  Here
+the same two axes become one 2-D ``jax.sharding.Mesh``:
+
+- ``data``  — batch dimension (sync data parallelism; replaces async
+  between-graph worker replication),
+- ``model`` — table rows (replaces PS block partitioning).
+
+All cross-chip traffic is XLA collectives over ICI/DCN inserted by GSPMD
+from these shardings; there is no user-visible comms API (SURVEY.md §2
+"Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.models.fm import FmParams
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    cfg: FmConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build the (data, model) mesh.
+
+    ``mesh_data``/``mesh_model`` come from the config; if both are 1 and
+    several devices are visible, all devices go to the data axis (pure DP),
+    matching the reference default of one PS "block" per worker set.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    d, m = cfg.mesh_data, cfg.mesh_model
+    if d * m == 1 and n > 1:
+        d, m = n, 1
+    if d * m > n:
+        raise ValueError(f"mesh {d}x{m} needs {d * m} devices, have {n}")
+    grid = np.array(devices[: d * m]).reshape(d, m)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def param_sharding(mesh: Mesh) -> FmParams:
+    """Table rows sharded over `model`, replicated over `data`."""
+    return FmParams(
+        w0=NamedSharding(mesh, P()),
+        table=NamedSharding(mesh, P(MODEL_AXIS, None)),
+    )
+
+
+def batch_sharding(mesh: Mesh):
+    """Batch arrays sharded over `data`, replicated over `model`.
+
+    Returns a dict keyed like data.libsvm.Batch fields.
+    """
+    ex = NamedSharding(mesh, P(DATA_AXIS))
+    feat = NamedSharding(mesh, P(DATA_AXIS, None))
+    return {
+        "labels": ex,
+        "ids": feat,
+        "vals": feat,
+        "fields": feat,
+        "weights": ex,
+    }
+
+
+def shard_params(params: FmParams, mesh: Mesh) -> FmParams:
+    sh = param_sharding(mesh)
+    return jax.tree.map(jax.device_put, params, sh)
+
+
+def shard_batch(batch, mesh: Mesh):
+    sh = batch_sharding(mesh)
+    return type(batch)(
+        *(jax.device_put(getattr(batch, k), sh[k]) for k in batch._fields)
+    )

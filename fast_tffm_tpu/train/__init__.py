@@ -1,0 +1,1 @@
+from fast_tffm_tpu.train.optimizers import make_optimizer  # noqa: F401

@@ -1,0 +1,98 @@
+"""Checkpoint/resume via Orbax (reference: ``tf.train.Saver`` -> model_file).
+
+The reference saves to the ``model_file`` cfg path and warm-starts from it
+(SURVEY.md §5 "Checkpoint / resume").  Here ``model_file`` is a directory
+with two Orbax checkpoints:
+
+- ``<model_file>/params`` — model params + step (the "model"),
+- ``<model_file>/opt``    — optimizer accumulators (Adagrad/FTRL slots).
+
+They are split so a warm start into a *different* optimizer (the
+Adagrad-vs-FTRL sweep, BASELINE config 3) restores the model and freshly
+initializes the new optimizer's state.  Arrays are saved with their
+shardings, so a row-sharded table checkpoints and restores shard-by-shard
+without ever being gathered to one host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
+
+
+def _params_dir(model_file: str) -> str:
+    return os.path.join(os.path.abspath(model_file), "params")
+
+
+def _opt_dir(model_file: str) -> str:
+    return os.path.join(os.path.abspath(model_file), "opt")
+
+
+def save(
+    model_file: str,
+    step: int,
+    params: Any,
+    opt_state: Any = None,
+) -> None:
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(
+            _params_dir(model_file),
+            {"params": params, "step": np.int64(step)},
+            force=True,
+        )
+        if opt_state is not None:
+            ckptr.save(_opt_dir(model_file), {"opt_state": opt_state}, force=True)
+    log.info("saved checkpoint step=%d to %s", step, model_file)
+
+
+def exists(model_file: str) -> bool:
+    d = _params_dir(model_file)
+    return os.path.isdir(d) and bool(os.listdir(d))
+
+
+def _restore_args_for(template):
+    def args(x):
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None:
+            return ocp.ArrayRestoreArgs(sharding=sharding)
+        return ocp.RestoreArgs()  # plain numpy leaf (e.g. the step counter)
+
+    return jax.tree.map(args, template)
+
+
+def restore_params(model_file: str, template: Any) -> tuple[Any, int]:
+    """Restore (params, step). ``template`` is a params pytree of
+    ShapeDtypeStructs carrying target shardings."""
+    item = {"params": template, "step": np.int64(0)}
+    with ocp.PyTreeCheckpointer() as ckptr:
+        got = ckptr.restore(
+            _params_dir(model_file),
+            item=item,
+            restore_args=_restore_args_for(item),
+        )
+    return got["params"], int(got["step"])
+
+
+def restore_opt(model_file: str, template: Any) -> Optional[Any]:
+    """Restore optimizer state, or None if absent/incompatible (e.g. the
+    checkpoint came from a different optimizer in a sweep)."""
+    d = _opt_dir(model_file)
+    if not (os.path.isdir(d) and os.listdir(d)):
+        return None
+    item = {"opt_state": template}
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            got = ckptr.restore(d, item=item, restore_args=_restore_args_for(item))
+        return got["opt_state"]
+    except Exception as e:
+        log.warning(
+            "optimizer state in %s incompatible (%s); reinitializing", d, e
+        )
+        return None

@@ -1,0 +1,334 @@
+"""Training loop: the `local_train` / `dist_train` engine.
+
+One jitted train step (loss+grad+optimizer+metrics) over a (data, model)
+mesh replaces the reference's per-batch ``sess.run(train_op)`` hot loop and
+its async PS updates (SURVEY.md §3.1/3.2).  Updates are synchronous — GSPMD
+allreduces gradients over ICI — which is a deliberate semantic upgrade from
+hogwild PS training (SURVEY.md §7 step 4 notes the convergence difference).
+
+Host-side, batches parse on background threads (data.pipeline) while the
+device runs the current step; the donated carry keeps the step fully
+async-dispatched.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.libsvm import Batch
+from fast_tffm_tpu.data.pipeline import BatchPipeline
+from fast_tffm_tpu.models import fm
+from fast_tffm_tpu.parallel import mesh as mesh_lib
+from fast_tffm_tpu.train import checkpoint, metrics as metrics_lib
+from fast_tffm_tpu.train.optimizers import make_optimizer
+
+log = logging.getLogger(__name__)
+
+
+class MetricState(NamedTuple):
+    loss_sum: jax.Array  # weighted sum of per-example data losses
+    weight_sum: jax.Array
+    auc: metrics_lib.AucState
+
+    @staticmethod
+    def zeros() -> "MetricState":
+        return MetricState(
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+            metrics_lib.auc_init(),
+        )
+
+
+class TrainState(NamedTuple):
+    params: fm.FmParams
+    opt_state: tuple
+    metrics: MetricState
+    step: jax.Array
+
+
+def _metric_update(
+    ms: MetricState, scores, labels, weights, loss_type: str
+) -> MetricState:
+    lsum, wsum = metrics_lib.weighted_loss(scores, labels, weights, loss_type)
+    return MetricState(
+        loss_sum=ms.loss_sum + lsum,
+        weight_sum=ms.weight_sum + wsum,
+        auc=metrics_lib.auc_update(ms.auc, scores, labels, weights),
+    )
+
+
+def make_train_step(cfg: FmConfig, optimizer):
+    """Returns step(state, batch) -> state, jit-ready."""
+
+    def step(state: TrainState, batch: Batch) -> TrainState:
+        def loss_fn(params):
+            return fm.loss_and_metrics(
+                params,
+                batch.labels,
+                batch.ids,
+                batch.vals,
+                batch.fields if cfg.field_num else None,
+                batch.weights,
+                cfg,
+            )
+
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        ms = _metric_update(
+            state.metrics, aux["scores"], batch.labels, batch.weights,
+            cfg.loss_type,
+        )
+        return TrainState(params, opt_state, ms, state.step + 1)
+
+    return step
+
+
+def make_eval_step(cfg: FmConfig):
+    def step(params: fm.FmParams, ms: MetricState, batch: Batch) -> MetricState:
+        scores = fm.fm_scores(
+            params,
+            batch.ids,
+            batch.vals,
+            batch.fields if cfg.field_num else None,
+            factor_num=cfg.factor_num,
+            field_num=cfg.field_num,
+        )
+        return _metric_update(
+            ms, scores, batch.labels, batch.weights, cfg.loss_type
+        )
+
+    return step
+
+
+def _finalize_metrics(ms: MetricState, loss_type: str = "logistic") -> dict:
+    """Streaming means. The loss key is "logloss" for logistic training and
+    "mse" for mse training (plus a loss_type-agnostic "loss" alias)."""
+    wsum = max(float(ms.weight_sum), 1e-12)
+    loss = float(ms.loss_sum) / wsum
+    out = {
+        "loss": loss,
+        "auc": float(metrics_lib.auc_finalize(ms.auc)),
+        "examples": float(ms.weight_sum),
+    }
+    out["mse" if loss_type == "mse" else "logloss"] = loss
+    return out
+
+
+def _params_template(cfg: FmConfig, param_sh):
+    shapes = jax.eval_shape(partial(fm.init_params, cfg=cfg), jax.random.PRNGKey(0))
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        param_sh,
+    )
+
+
+class Trainer:
+    """Drives training per an FmConfig — the `local_train` engine.
+
+    With a multi-device mesh this same class is the `dist_train` engine:
+    the only difference is the mesh passed in (and, multi-host, a
+    jax.distributed.initialize() call before construction — see
+    train.dist).
+    """
+
+    def __init__(self, cfg: FmConfig, mesh=None):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
+        self.optimizer = make_optimizer(cfg)
+        if cfg.batch_size % self.mesh.shape[mesh_lib.DATA_AXIS] != 0:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} not divisible by data-mesh "
+                f"size {self.mesh.shape[mesh_lib.DATA_AXIS]}"
+            )
+
+        param_sh = mesh_lib.param_sharding(self.mesh)
+        self._param_sh = param_sh
+        self._batch_sh = Batch(**mesh_lib.batch_sharding(self.mesh))
+        rep = NamedSharding(self.mesh, P())
+
+        params, opt_state = self._init_or_restore(param_sh)
+        self.state = TrainState(
+            params=params,
+            opt_state=opt_state,
+            metrics=jax.device_put(MetricState.zeros(), rep),
+            step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+        )
+
+        state_sh = jax.tree.map(lambda x: x.sharding, self.state)
+        self._train_step = jax.jit(
+            make_train_step(cfg, self.optimizer),
+            in_shardings=(state_sh, self._batch_sh),
+            out_shardings=state_sh,
+            donate_argnums=0,
+        )
+        ms_sh = jax.tree.map(lambda _: rep, MetricState.zeros())
+        self._eval_step = jax.jit(
+            make_eval_step(cfg),
+            in_shardings=(state_sh.params, ms_sh, self._batch_sh),
+            out_shardings=ms_sh,
+            donate_argnums=1,
+        )
+
+    def _opt_shardings(self, param_sh, params_template):
+        """Sharding for each optimizer-state leaf: table-shaped accumulators
+        follow the table's row sharding, everything else is replicated
+        (SURVEY.md §7 hard-part 4: optimizer state never gathers)."""
+        rep = NamedSharding(self.mesh, P())
+        table_shape = params_template.table.shape
+        opt_shapes = jax.eval_shape(self.optimizer.init, params_template)
+        return jax.tree.map(
+            lambda s: param_sh.table if s.shape == table_shape else rep,
+            opt_shapes,
+        )
+
+    def _init_or_restore(self, param_sh):
+        cfg = self.cfg
+        template = _params_template(cfg, param_sh)
+        opt_sh = self._opt_shardings(param_sh, template)
+        opt_init = jax.jit(self.optimizer.init, out_shardings=opt_sh)
+        if checkpoint.exists(cfg.model_file):
+            log.info("warm-starting from %s", cfg.model_file)
+            params, self._restored_step = checkpoint.restore_params(
+                cfg.model_file, template
+            )
+            params = fm.FmParams(*params)
+            opt_shapes = jax.eval_shape(self.optimizer.init, template)
+            opt_template = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                opt_shapes,
+                opt_sh,
+            )
+            opt_state = checkpoint.restore_opt(cfg.model_file, opt_template)
+            if opt_state is None:
+                opt_state = opt_init(params)
+            return params, opt_state
+        self._restored_step = 0
+        init = jax.jit(partial(fm.init_params, cfg=cfg), out_shardings=param_sh)
+        params = init(jax.random.PRNGKey(cfg.seed))
+        return params, opt_init(params)
+
+    def _put(self, batch: Batch) -> Batch:
+        return mesh_lib.shard_batch(batch, self.mesh)
+
+    def reset_metrics(self):
+        rep = NamedSharding(self.mesh, P())
+        self.state = self.state._replace(
+            metrics=jax.device_put(MetricState.zeros(), rep)
+        )
+
+    def train(self) -> dict:
+        cfg = self.cfg
+        if not cfg.train_files:
+            raise ValueError("no train_files configured")
+        pipeline = BatchPipeline(
+            cfg.train_files,
+            cfg,
+            weight_files=cfg.weight_files or None,
+            epochs=cfg.epoch_num,
+            shuffle=True,
+        )
+        t0 = time.time()
+        last_log_t, last_log_ex = t0, 0.0
+        seen = 0.0
+        stepno = 0
+        for batch in pipeline:
+            self.state = self._train_step(self.state, self._put(batch))
+            stepno += 1
+            seen += float(np.sum(batch.weights > 0))
+            if cfg.log_steps and stepno % cfg.log_steps == 0:
+                m = _finalize_metrics(self.state.metrics, cfg.loss_type)
+                now = time.time()
+                rate = (seen - last_log_ex) / max(now - last_log_t, 1e-9)
+                last_log_t, last_log_ex = now, seen
+                log.info(
+                    "step %d examples %d loss %.6f auc %.4f ex/s %.0f",
+                    stepno, int(seen), m["loss"], m["auc"], rate,
+                )
+            if cfg.save_steps and stepno % cfg.save_steps == 0:
+                self.save(stepno)
+        train_metrics = _finalize_metrics(self.state.metrics, cfg.loss_type)
+        train_metrics["examples_per_sec"] = seen / max(time.time() - t0, 1e-9)
+        train_metrics["steps"] = stepno
+        self.save(stepno)
+        result = {"train": train_metrics}
+        if cfg.validation_files:
+            result["validation"] = self.evaluate(cfg.validation_files)
+            log.info(
+                "validation loss %.6f auc %.4f",
+                result["validation"]["loss"],
+                result["validation"]["auc"],
+            )
+        return result
+
+    def evaluate(self, files) -> dict:
+        rep = NamedSharding(self.mesh, P())
+        ms = jax.device_put(MetricState.zeros(), rep)
+        pipeline = BatchPipeline(files, self.cfg, epochs=1, shuffle=False)
+        for batch in pipeline:
+            ms = self._eval_step(self.state.params, ms, self._put(batch))
+        return _finalize_metrics(ms, self.cfg.loss_type)
+
+    def save(self, stepno: int):
+        checkpoint.save(
+            self.cfg.model_file,
+            self._restored_step + stepno,
+            self.state.params,
+            self.state.opt_state,
+        )
+
+
+def predict(cfg: FmConfig, mesh=None) -> int:
+    """Score predict_files into score_path (reference predict mode, §3.3).
+
+    Scores are written in input order, one per line — sigmoid probabilities
+    for logistic loss, raw scores for mse.
+    """
+    if not cfg.predict_files:
+        raise ValueError("no predict_files configured")
+    mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
+    param_sh = mesh_lib.param_sharding(mesh)
+    template = _params_template(cfg, param_sh)
+    params, _ = checkpoint.restore_params(cfg.model_file, template)
+    params = fm.FmParams(*params)
+
+    batch_sh = Batch(**mesh_lib.batch_sharding(mesh))
+
+    @partial(jax.jit, in_shardings=(param_sh, batch_sh))
+    def score_fn(params, batch):
+        s = fm.fm_scores(
+            params,
+            batch.ids,
+            batch.vals,
+            batch.fields if cfg.field_num else None,
+            factor_num=cfg.factor_num,
+            field_num=cfg.field_num,
+        )
+        if cfg.loss_type == "logistic":
+            s = jax.nn.sigmoid(s)
+        return s
+
+    pipeline = BatchPipeline(
+        cfg.predict_files, cfg, epochs=1, shuffle=False, ordered=True
+    )
+    n = 0
+    with open(cfg.score_path, "w") as out:
+        for batch in pipeline:
+            scores = np.asarray(score_fn(params, mesh_lib.shard_batch(batch, mesh)))
+            for s in scores[batch.weights > 0]:
+                out.write(f"{s:.6f}\n")
+                n += 1
+    log.info("wrote %d scores to %s", n, cfg.score_path)
+    return n

@@ -1,0 +1,115 @@
+"""Optimizers: Adagrad and FTRL-proximal (reference sweep, SURVEY.md §2 #8).
+
+The reference uses ``tf.train.AdagradOptimizer`` (cfg keys ``learning_rate``
+and ``adagrad.initial_accumulator``) and names an Adagrad-vs-FTRL sweep.
+optax ships Adagrad; FTRL-proximal (McMahan et al., the standard CTR
+optimizer) is implemented here as an optax GradientTransformation since
+optax has none.
+
+Optimizer state has the same pytree structure (and hence the same sharding)
+as the parameters, so a row-sharded table gets row-sharded accumulators and
+optimizer updates never gather the table (SURVEY.md §7 hard-part 4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fast_tffm_tpu.config import FmConfig
+
+
+class FtrlState(NamedTuple):
+    z: optax.Params  # per-weight linear accumulator
+    n: optax.Params  # per-weight squared-gradient accumulator
+
+
+def ftrl(
+    learning_rate: float,
+    l1: float = 0.0,
+    l2: float = 0.0,
+    beta: float = 1.0,
+    initial_accumulator: float = 0.1,
+) -> optax.GradientTransformation:
+    """FTRL-proximal.
+
+    Follows the standard per-coordinate recursion:
+
+        n_{t+1} = n_t + g^2
+        sigma   = (sqrt(n_{t+1}) - sqrt(n_t)) / lr
+        z_{t+1} = z_t + g - sigma * w_t
+        w_{t+1} = 0                                    if |z| <= l1
+                = -(z - sign(z)*l1)
+                  / ((beta + sqrt(n_{t+1})) / lr + l2)  otherwise
+
+    Returned as an update: ``u = w_{t+1} - w_t`` so it composes with
+    ``optax.apply_updates``.
+    """
+
+    def init_fn(params):
+        # z chosen so the closed-form w(z, n) reproduces the incoming params
+        # exactly: w = -(z - sign(z)*l1)/denom  ⇒  z = -w*denom - sign(w)*l1.
+        # With z=0 the first update would overwrite warm-started weights
+        # (the Adagrad->FTRL sweep warm start, BASELINE config 3).
+        def z_from_w(w):
+            denom = (beta + jnp.sqrt(initial_accumulator)) / learning_rate + l2
+            return -w * denom - jnp.sign(w) * l1
+
+        z = jax.tree.map(z_from_w, params)
+        n = jax.tree.map(
+            lambda p: jnp.full_like(p, initial_accumulator), params
+        )
+        return FtrlState(z=z, n=n)
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("ftrl requires params (pass them to update)")
+        n_new = jax.tree.map(lambda g, n: n + g * g, grads, state.n)
+        z_new = jax.tree.map(
+            lambda g, z, n, nn, w: z
+            + g
+            - (jnp.sqrt(nn) - jnp.sqrt(n)) / learning_rate * w,
+            grads,
+            state.z,
+            state.n,
+            n_new,
+            params,
+        )
+
+        def solve(z, nn, w):
+            denom = (beta + jnp.sqrt(nn)) / learning_rate + l2
+            w_new = jnp.where(
+                jnp.abs(z) <= l1,
+                jnp.zeros_like(w),
+                -(z - jnp.sign(z) * l1) / denom,
+            )
+            return w_new - w
+
+        updates = jax.tree.map(solve, z_new, n_new, params)
+        return updates, FtrlState(z=z_new, n=n_new)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def make_optimizer(cfg: FmConfig) -> optax.GradientTransformation:
+    if cfg.optimizer == "adagrad":
+        return optax.adagrad(
+            learning_rate=cfg.learning_rate,
+            initial_accumulator_value=cfg.adagrad_initial_accumulator,
+        )
+    if cfg.optimizer == "ftrl":
+        return ftrl(
+            learning_rate=cfg.learning_rate,
+            l1=cfg.ftrl_l1,
+            l2=cfg.ftrl_l2,
+            beta=cfg.ftrl_beta,
+            initial_accumulator=cfg.adagrad_initial_accumulator,
+        )
+    if cfg.optimizer == "sgd":
+        return optax.sgd(cfg.learning_rate)
+    if cfg.optimizer == "adam":
+        return optax.adam(cfg.learning_rate)
+    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")

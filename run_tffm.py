@@ -1,0 +1,9 @@
+#!/usr/bin/env python
+"""Entry point matching the reference CLI: run_tffm.py {train|predict} <cfg>."""
+
+import sys
+
+from fast_tffm_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

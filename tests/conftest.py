@@ -1,0 +1,34 @@
+"""Test environment: force an 8-device virtual CPU mesh.
+
+This is the TPU-world analogue of the reference's "localhost PS cluster"
+smoke tests (SURVEY.md §4): multi-chip sharding paths run on 8 fake CPU
+devices so the full mesh logic is exercised without TPU hardware.
+
+Note on this machine's TPU tunnel: a global sitecustomize registers an
+'axon' PJRT plugin and sets ``jax_platforms="axon,cpu"`` via jax.config
+(which overrides the JAX_PLATFORMS env var), and initializing that backend
+dials a remote TPU. Tests must stay CPU-only and leave the tunnel alone, so
+we set the XLA flag before importing jax, then force the platform list back
+to "cpu" through jax.config.
+"""
+
+import os
+
+# Must happen before jax initializes its CPU client.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

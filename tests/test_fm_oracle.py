@@ -1,0 +1,156 @@
+"""FM oracle numeric tests: sum-square trick vs brute-force pairwise sum."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.models import fm
+
+
+def brute_force_fm(w0, table, ids, vals, k):
+    """O(F^2) pairwise definition of the 2nd-order FM score."""
+    b, f = ids.shape
+    out = np.zeros(b)
+    for e in range(b):
+        s = float(w0)
+        for i in range(f):
+            s += table[ids[e, i], 0] * vals[e, i]
+        for i in range(f):
+            for j in range(i + 1, f):
+                vi = table[ids[e, i], 1 : 1 + k]
+                vj = table[ids[e, j], 1 : 1 + k]
+                s += float(np.dot(vi, vj)) * vals[e, i] * vals[e, j]
+        out[e] = s
+    return out
+
+
+@pytest.fixture
+def small_problem(rng):
+    vocab, k, b, f = 50, 4, 8, 5
+    table = rng.normal(size=(vocab, 1 + k)).astype(np.float32) * 0.1
+    ids = rng.integers(0, vocab, size=(b, f)).astype(np.int32)
+    vals = rng.normal(size=(b, f)).astype(np.float32)
+    return table, ids, vals, k
+
+
+def test_sum_square_trick_matches_brute_force(small_problem):
+    table, ids, vals, k = small_problem
+    params = fm.FmParams(w0=jnp.float32(0.3), table=jnp.asarray(table))
+    got = fm.fm_scores(params, jnp.asarray(ids), jnp.asarray(vals), factor_num=k)
+    want = brute_force_fm(0.3, table, ids, vals, k)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_padding_is_inert(small_problem):
+    """val==0 slots must not change scores (SURVEY.md §7 static-shape rule)."""
+    table, ids, vals, k = small_problem
+    params = fm.FmParams(w0=jnp.float32(0.0), table=jnp.asarray(table))
+    base = fm.fm_scores(params, jnp.asarray(ids), jnp.asarray(vals), factor_num=k)
+    # Append padded columns: arbitrary ids, zero vals.
+    ids_pad = np.concatenate([ids, np.full((ids.shape[0], 3), 7, np.int32)], axis=1)
+    vals_pad = np.concatenate([vals, np.zeros((vals.shape[0], 3), np.float32)], axis=1)
+    padded = fm.fm_scores(
+        params, jnp.asarray(ids_pad), jnp.asarray(vals_pad), factor_num=k
+    )
+    np.testing.assert_allclose(np.asarray(base), np.asarray(padded), rtol=1e-6)
+
+
+def brute_force_ffm(w0, table, ids, vals, fields, k, field_num):
+    b, f = ids.shape
+    out = np.zeros(b)
+    for e in range(b):
+        s = float(w0)
+        for i in range(f):
+            s += table[ids[e, i], 0] * vals[e, i]
+        V = table[:, 1:].reshape(table.shape[0], field_num, k)
+        for i in range(f):
+            for j in range(i + 1, f):
+                vi = V[ids[e, i], fields[e, j]]
+                vj = V[ids[e, j], fields[e, i]]
+                s += float(np.dot(vi, vj)) * vals[e, i] * vals[e, j]
+        out[e] = s
+    return out
+
+
+def test_ffm_matches_brute_force(rng):
+    vocab, k, field_num, b, f = 30, 3, 4, 6, 5
+    table = rng.normal(size=(vocab, 1 + field_num * k)).astype(np.float32) * 0.1
+    ids = rng.integers(0, vocab, size=(b, f)).astype(np.int32)
+    vals = rng.normal(size=(b, f)).astype(np.float32)
+    fields = rng.integers(0, field_num, size=(b, f)).astype(np.int32)
+    params = fm.FmParams(w0=jnp.float32(0.1), table=jnp.asarray(table))
+    got = fm.fm_scores(
+        params,
+        jnp.asarray(ids),
+        jnp.asarray(vals),
+        jnp.asarray(fields),
+        factor_num=k,
+        field_num=field_num,
+    )
+    want = brute_force_ffm(0.1, table, ids, vals, fields, k, field_num)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_loss_logistic_gradient_finite_diff(rng):
+    cfg = FmConfig(vocabulary_size=20, factor_num=3, loss_type="logistic")
+    params = fm.init_params(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(rng.integers(0, 20, size=(4, 3)), jnp.int32)
+    vals = jnp.asarray(rng.normal(size=(4, 3)), jnp.float32)
+    labels = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    weights = jnp.ones((4,))
+
+    def f(p):
+        loss, _ = fm.loss_and_metrics(p, labels, ids, vals, None, weights, cfg)
+        return loss
+
+    g = jax.grad(f)(params)
+    # Finite-difference check on w0.
+    eps = 1e-3
+    up = f(params._replace(w0=params.w0 + eps))
+    dn = f(params._replace(w0=params.w0 - eps))
+    np.testing.assert_allclose(g.w0, (up - dn) / (2 * eps), rtol=1e-3, atol=1e-4)
+
+
+def test_loss_weights_mask_padded_examples(rng):
+    cfg = FmConfig(vocabulary_size=20, factor_num=3)
+    params = fm.init_params(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(rng.integers(0, 20, size=(4, 3)), jnp.int32)
+    vals = jnp.asarray(rng.normal(size=(4, 3)), jnp.float32)
+    labels = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    # Padded tail example (weight 0) must not affect the loss.
+    w_full = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    loss_a, _ = fm.loss_and_metrics(
+        params, labels, ids, vals, None, w_full, cfg
+    )
+    loss_b, _ = fm.loss_and_metrics(
+        params,
+        labels.at[3].set(123.0),
+        ids,
+        vals,
+        None,
+        w_full,
+        cfg,
+    )
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+
+
+def test_l2_modes(rng):
+    ids = jnp.asarray(rng.integers(0, 20, size=(4, 3)), jnp.int32)
+    vals = jnp.ones((4, 3), jnp.float32)
+    labels = jnp.zeros((4,))
+    weights = jnp.ones((4,))
+    for mode in ("batch", "full"):
+        cfg = FmConfig(
+            vocabulary_size=20,
+            factor_num=3,
+            factor_lambda=0.1,
+            bias_lambda=0.05,
+            l2_mode=mode,
+        )
+        params = fm.init_params(jax.random.PRNGKey(0), cfg)
+        loss, aux = fm.loss_and_metrics(params, labels, ids, vals, None, weights, cfg)
+        assert float(aux["reg"]) > 0
+        assert float(loss) > float(aux["data_loss"])

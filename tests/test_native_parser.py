@@ -1,0 +1,122 @@
+"""C++ parser vs Python oracle: bit-exact agreement (SURVEY.md §2 #1)."""
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.data import libsvm
+
+native = pytest.importorskip("fast_tffm_tpu.data.native")
+
+
+@pytest.fixture(scope="module")
+def built():
+    try:
+        native._load()
+    except Exception as e:
+        pytest.skip(f"native parser build failed: {e}")
+    return True
+
+
+def test_murmur_matches_python(built):
+    for token in [b"", b"a", b"abcdefg", b"abcdefgh", b"abcdefghi",
+                  b"userid_12345", "féature".encode("utf-8"), b"x" * 1000]:
+        assert native.murmur64_native(token) == libsvm.murmur64(token), token
+
+
+def _random_lines(rng, n, vocab, ffm=False, hash_ids=False):
+    lines = []
+    for _ in range(n):
+        label = rng.choice(["1", "0", "-1"])
+        nf = rng.integers(1, 12)
+        toks = []
+        for _ in range(nf):
+            if hash_ids:
+                fid = "feat_" + str(rng.integers(0, 10**9))
+            else:
+                fid = str(rng.integers(0, vocab * 2))  # exercise mod wrap
+            val = f"{rng.uniform(-2, 2):.4f}"
+            if ffm:
+                toks.append(f"{rng.integers(0, 99)}:{fid}:{val}")
+            elif rng.uniform() < 0.1:
+                toks.append(fid)  # bare feature
+            else:
+                toks.append(f"{fid}:{val}")
+        lines.append(f"{label} {' '.join(toks)}")
+    return lines
+
+
+@pytest.mark.parametrize("ffm,hash_ids", [(False, False), (False, True),
+                                          (True, False), (True, True)])
+def test_native_matches_oracle(built, rng, ffm, hash_ids):
+    vocab, max_features, field_num = 1000, 16, 7
+    lines = _random_lines(rng, 64, vocab, ffm, hash_ids)
+    parser = native.NativeParser(
+        vocab, max_features, hash_feature_id=hash_ids,
+        field_num=field_num if ffm else 0, num_threads=4,
+    )
+    got = parser.parse_batch(lines, batch_size=64)
+    exs = libsvm.parse_lines(lines, vocab, hash_ids, field_num if ffm else 0)
+    want = libsvm.make_batch(exs, 64, max_features)
+    np.testing.assert_array_equal(got.labels, want.labels)
+    np.testing.assert_array_equal(got.ids, want.ids)
+    np.testing.assert_array_equal(got.vals, want.vals)
+    np.testing.assert_array_equal(got.fields, want.fields)
+    np.testing.assert_array_equal(got.weights, want.weights)
+
+
+def test_native_truncation_counted(built):
+    parser = native.NativeParser(100, 2, num_threads=1)
+    parser.parse_batch(["1 1:1 2:1 3:1 4:1"], batch_size=1)
+    assert parser.truncated_features == 2
+
+
+def test_native_weights(built):
+    parser = native.NativeParser(100, 4, num_threads=1)
+    b = parser.parse_batch(["1 1:1", "0 2:1"], batch_size=4, weights=[0.5, 2.0])
+    np.testing.assert_array_equal(b.weights, [0.5, 2.0, 0, 0])
+
+
+def test_native_malformed_raises(built):
+    parser = native.NativeParser(100, 4, num_threads=1)
+    for bad in [
+        "1 a:b:c:d",      # too many colons
+        "notalabel 1:1",  # non-numeric label
+        "1x 1:1",         # partially-numeric label (float('1x') raises)
+        "1 :2",           # empty integer id (int('') raises)
+        "1 3:",           # empty value (float('') raises)
+        "1 :5:0.5",       # empty field (int('') raises)
+    ]:
+        with pytest.raises(ValueError):
+            parser.parse_batch([bad], batch_size=1)
+    # Error message names the offending line.
+    with pytest.raises(ValueError, match="batch line 1"):
+        parser.parse_batch(["1 1:1", "0 bad::x"], batch_size=2)
+
+
+def test_native_malformed_beyond_truncation_still_raises(built):
+    """A malformed token past max_features must error (like the oracle),
+    not be silently dropped by truncation."""
+    parser = native.NativeParser(100, 2, num_threads=1)
+    with pytest.raises(ValueError):
+        parser.parse_batch(["1 1:1 2:1 3:1 bad:"], batch_size=1)
+
+
+def test_native_empty_hash_id_matches_oracle(built):
+    """Hash mode hashes the empty string (Python murmur64(b'') is valid)."""
+    parser = native.NativeParser(100, 4, hash_feature_id=True, num_threads=1)
+    got = parser.parse_batch(["1 :2.0"], batch_size=1)
+    exs = libsvm.parse_lines(["1 :2.0"], 100, hash_feature_id=True)
+    want = libsvm.make_batch(exs, 1, 4)
+    np.testing.assert_array_equal(got.ids, want.ids)
+    np.testing.assert_array_equal(got.vals, want.vals)
+
+
+def test_native_multithreaded_large_batch(built, rng):
+    vocab = 5000
+    lines = _random_lines(rng, 2048, vocab)
+    parser = native.NativeParser(vocab, 16, num_threads=8)
+    got = parser.parse_batch(lines, batch_size=2048)
+    exs = libsvm.parse_lines(lines, vocab)
+    want = libsvm.make_batch(exs, 2048, 16)
+    np.testing.assert_array_equal(got.ids, want.ids)
+    np.testing.assert_array_equal(got.vals, want.vals)

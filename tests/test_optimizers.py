@@ -1,0 +1,98 @@
+"""Optimizer tests: Adagrad config mapping and FTRL-proximal behavior."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.train import optimizers
+
+
+def test_make_optimizer_variants():
+    for name in ("adagrad", "ftrl", "sgd", "adam"):
+        cfg = FmConfig(optimizer=name)
+        opt = optimizers.make_optimizer(cfg)
+        params = {"w": jnp.ones((3,))}
+        state = opt.init(params)
+        grads = {"w": jnp.ones((3,))}
+        updates, _ = opt.update(grads, state, params)
+        assert updates["w"].shape == (3,)
+
+
+def test_ftrl_reference_implementation():
+    """Step-by-step FTRL-proximal recursion vs a numpy re-derivation."""
+    lr, l1, l2, beta, init_acc = 0.1, 0.01, 0.02, 1.0, 0.0
+    opt = optimizers.ftrl(lr, l1, l2, beta, initial_accumulator=init_acc)
+    w = jnp.array([0.0, 0.0, 0.0])
+    state = opt.init(w)
+    rng = np.random.default_rng(1)
+
+    z = np.zeros(3)
+    n = np.zeros(3)
+    w_np = np.zeros(3)
+    for _ in range(5):
+        g = rng.normal(size=3).astype(np.float32)
+        updates, state = opt.update(jnp.asarray(g), state, w)
+        w = optax.apply_updates(w, updates)
+        # numpy reference
+        n_new = n + g * g
+        sigma = (np.sqrt(n_new) - np.sqrt(n)) / lr
+        z = z + g - sigma * w_np
+        n = n_new
+        w_np = np.where(
+            np.abs(z) <= l1,
+            0.0,
+            -(z - np.sign(z) * l1) / ((beta + np.sqrt(n)) / lr + l2),
+        )
+        np.testing.assert_allclose(np.asarray(w), w_np, rtol=1e-5, atol=1e-6)
+
+
+def test_ftrl_zero_grad_preserves_warm_started_params():
+    """Regression: z must be initialized from the incoming params, so a
+    warm start into FTRL (Adagrad->FTRL sweep) doesn't discard the model."""
+    for l1, l2 in [(0.0, 0.0), (0.01, 0.02)]:
+        opt = optimizers.ftrl(0.1, l1=l1, l2=l2, initial_accumulator=0.1)
+        w = jnp.array([0.7, -1.3, 0.0, 0.05])
+        state = opt.init(w)
+        updates, _ = opt.update(jnp.zeros_like(w), state, w)
+        w2 = optax.apply_updates(w, updates)
+        np.testing.assert_allclose(np.asarray(w2), np.asarray(w),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ftrl_l1_produces_sparsity():
+    # Huge l1: small gradients never push |z| past l1, weights stay 0.
+    opt = optimizers.ftrl(0.1, l1=10.0)
+    w = jnp.array([0.0, 0.0])
+    state = opt.init(w)
+    for _ in range(5):
+        updates, state = opt.update(jnp.array([0.01, -0.01]), state, w)
+        w = optax.apply_updates(w, updates)
+    np.testing.assert_allclose(np.asarray(w), [0.0, 0.0], atol=1e-7)
+
+
+def test_adagrad_initial_accumulator_used():
+    cfg = FmConfig(optimizer="adagrad", adagrad_initial_accumulator=123.0,
+                   learning_rate=1.0)
+    opt = optimizers.make_optimizer(cfg)
+    w = jnp.array([0.0])
+    state = opt.init(w)
+    updates, _ = opt.update(jnp.array([1.0]), state, w)
+    # Adagrad: u = -lr * g / sqrt(acc + g^2); acc starts at 123.
+    np.testing.assert_allclose(
+        np.asarray(updates), -1.0 / np.sqrt(124.0), rtol=1e-5
+    )
+
+
+def test_optimizer_state_tree_matches_params():
+    """State must mirror the param tree so table sharding propagates."""
+    from fast_tffm_tpu.models import fm
+
+    cfg = FmConfig(vocabulary_size=64, factor_num=4, optimizer="ftrl")
+    params = fm.init_params(jax.random.PRNGKey(0), cfg)
+    opt = optimizers.make_optimizer(cfg)
+    state = opt.init(params)
+    assert state.z.table.shape == params.table.shape
+    assert state.n.table.shape == params.table.shape

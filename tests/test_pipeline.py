@@ -1,0 +1,122 @@
+"""Input-pipeline tests: epochs, shuffling, weights, ordering."""
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.pipeline import BatchPipeline, iter_lines
+
+
+@pytest.fixture
+def data_files(tmp_path):
+    a = tmp_path / "a.libsvm"
+    a.write_text("".join(f"1 {i}:1.0\n" for i in range(10)))
+    b = tmp_path / "b.libsvm"
+    b.write_text("".join(f"0 {i}:2.0\n" for i in range(10, 15)))
+    return [str(a), str(b)]
+
+
+def _cfg(**kw):
+    defaults = dict(
+        vocabulary_size=100, batch_size=4, max_features=4, thread_num=2,
+        queue_size=4, shuffle_buffer=8,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+def test_iter_lines_all_files(data_files):
+    lines = list(iter_lines(data_files))
+    assert len(lines) == 15
+    assert all(w == 1.0 for _, w in lines)
+
+
+def test_iter_lines_weight_files(data_files, tmp_path):
+    wa = tmp_path / "wa.txt"
+    wa.write_text("".join(f"{0.1 * (i + 1):.2f}\n" for i in range(10)))
+    wb = tmp_path / "wb.txt"
+    wb.write_text("".join("2.0\n" for _ in range(5)))
+    lines = list(iter_lines(data_files, [str(wa), str(wb)]))
+    ws = [w for _, w in lines]
+    np.testing.assert_allclose(ws[:10], [0.1 * (i + 1) for i in range(10)])
+    np.testing.assert_allclose(ws[10:], [2.0] * 5)
+
+
+def test_iter_lines_weights_align_past_blank_lines(tmp_path):
+    """Regression: weight line i pairs with data line i even when the data
+    file has blank/comment lines (which are skipped with their weights)."""
+    data = tmp_path / "d.libsvm"
+    data.write_text("1 1:1\n\n# comment\n0 2:1\n")
+    wf = tmp_path / "w.txt"
+    wf.write_text("0.5\n\n\n2.0\n")
+    lines = list(iter_lines([str(data)], [str(wf)]))
+    assert [w for _, w in lines] == [0.5, 2.0]
+
+
+def test_iter_lines_short_weight_file_raises(tmp_path):
+    data = tmp_path / "d.libsvm"
+    data.write_text("1 1:1\n0 2:1\n")
+    wf = tmp_path / "w.txt"
+    wf.write_text("0.5\n")
+    with pytest.raises(ValueError, match="does not pair"):
+        list(iter_lines([str(data)], [str(wf)]))
+
+
+def test_pipeline_covers_all_examples(data_files):
+    pipe = BatchPipeline(data_files, _cfg(), epochs=1, shuffle=False)
+    batches = list(pipe)
+    total = sum(int(np.sum(b.weights > 0)) for b in batches)
+    assert total == 15
+    # All batches padded to the static shape.
+    assert all(b.ids.shape == (4, 4) for b in batches)
+
+
+def test_pipeline_epochs(data_files):
+    pipe = BatchPipeline(data_files, _cfg(), epochs=3, shuffle=False)
+    total = sum(int(np.sum(b.weights > 0)) for b in pipe)
+    assert total == 45
+
+
+def test_pipeline_shuffle_changes_order(data_files):
+    cfg = _cfg(thread_num=1)
+    ordered = BatchPipeline(data_files, cfg, epochs=1, shuffle=False, ordered=True)
+    shuffled = BatchPipeline(
+        data_files, cfg, epochs=1, shuffle=True, seed=7, ordered=True
+    )
+    ids_a = np.concatenate([b.ids[b.vals > 0] for b in ordered])
+    ids_b = np.concatenate([b.ids[b.vals > 0] for b in shuffled])
+    assert sorted(ids_a.tolist()) == sorted(ids_b.tolist())
+    assert ids_a.tolist() != ids_b.tolist()
+
+
+def test_pipeline_ordered_preserves_input_order(data_files):
+    pipe = BatchPipeline(data_files, _cfg(), epochs=1, shuffle=False, ordered=True)
+    ids = np.concatenate([b.ids[b.vals > 0] for b in pipe])
+    assert ids.tolist() == list(range(15))
+
+
+def test_pipeline_raises_on_malformed_line(tmp_path):
+    """Regression: a bad line must raise promptly, not hang the pipeline."""
+    bad = tmp_path / "bad.libsvm"
+    bad.write_text("1 3:0.5 bad::token:extra\n")
+    pipe = BatchPipeline([str(bad)], _cfg(), epochs=1, shuffle=False)
+    with pytest.raises(ValueError):
+        list(pipe)
+
+
+def test_pipeline_raises_on_missing_weight_file(data_files):
+    pipe = BatchPipeline(
+        data_files, _cfg(), weight_files=["/nonexistent_w.txt", "/nope.txt"],
+        epochs=1, shuffle=False,
+    )
+    with pytest.raises(FileNotFoundError):
+        list(pipe)
+
+
+def test_pipeline_drop_remainder(data_files):
+    pipe = BatchPipeline(
+        data_files, _cfg(), epochs=1, shuffle=False, drop_remainder=True
+    )
+    batches = list(pipe)
+    assert all(int(np.sum(b.weights > 0)) == 4 for b in batches)
+    assert len(batches) == 3  # 15 // 4
