@@ -100,6 +100,10 @@ class FmConfig:
     compute_dtype: str = "float32"
     # Use the Pallas kernel for the scorer when on TPU.
     use_pallas: bool = True
+    # Sparse row updates (IndexedSlices-style): optimizer touches only the
+    # rows in the batch. Falls back to dense when the optimizer/l2_mode
+    # combination requires it (see train.sparse.supports_sparse).
+    sparse_update: bool = True
     # L2 mode: "batch" regularizes only the rows touched by the batch
     # (sparse-friendly); "full" regularizes the whole table (dense grads,
     # only sane for small vocabularies).
@@ -176,6 +180,7 @@ _KEYMAP = {
     "lookup": ("lookup", str),
     "compute_dtype": ("compute_dtype", str),
     "use_pallas": ("use_pallas", _parse_bool),
+    "sparse_update": ("sparse_update", _parse_bool),
     "l2_mode": ("l2_mode", str),
 }
 

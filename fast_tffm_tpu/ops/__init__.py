@@ -1,0 +1,1 @@
+from fast_tffm_tpu.ops.interaction import fm_interaction  # noqa: F401

@@ -1,0 +1,123 @@
+"""Pallas TPU kernels for the FM interaction — the FmScorer/FmGrad rebuild.
+
+The reference computes the 2nd-order FM score and its gradient in custom
+C++/CUDA ops (SURVEY.md §2 #2-3, §3.4).  Here both are fused Pallas TPU
+kernels over the *gathered* table rows:
+
+  forward:  rows [B,F,D], vals [B,F] -> scores [B]   (saves s1 [B,K])
+  backward: rows, vals, s1, dscores  -> per-occurrence row grads [B,F,D]
+
+The gather itself (``table[ids]``) and the scatter-add of row grads stay in
+XLA — its gather/scatter paths are the fast ones on TPU — while these
+kernels fuse all the elementwise/reduction math so the [B,F,K] ``xv``
+intermediates never touch HBM.
+
+Closed-form backward (SURVEY.md §3.4):
+  dV[b,f,k] = g_b * x_bf * (s1[b,k] - V[b,f,k]*x_bf)
+  dw[b,f]   = g_b * x_bf
+  dw0       = sum_b g_b            (computed by the caller)
+
+Both kernels are pure VPU work (no MXU): the op is bandwidth-bound, so the
+win is fusion, not FLOPs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _block_b(batch: int) -> int:
+    """Batch-tile size: cap VMEM use, keep sublane-aligned."""
+    for tb in (512, 256, 128, 64, 32, 16, 8):
+        if batch % tb == 0:
+            return tb
+    return batch
+
+
+def _fwd_kernel(rows_ref, vals_ref, score_ref, s1_ref):
+    rows = rows_ref[:]  # [TB, F, D]
+    vals = vals_ref[:]  # [TB, F]
+    w = rows[:, :, 0]
+    v = rows[:, :, 1:]
+    xv = v * vals[:, :, None]  # [TB, F, K]
+    s1 = jnp.sum(xv, axis=1)  # [TB, K]
+    s2 = jnp.sum(xv * xv, axis=1)
+    linear = jnp.sum(w * vals, axis=1)  # [TB]
+    inter = 0.5 * jnp.sum(s1 * s1 - s2, axis=-1)
+    score_ref[:] = (linear + inter)[:, None]  # [TB, 1]
+    s1_ref[:] = s1
+
+
+def _bwd_kernel(rows_ref, vals_ref, s1_ref, g_ref, drows_ref):
+    rows = rows_ref[:]  # [TB, F, D]
+    vals = vals_ref[:]  # [TB, F]
+    s1 = s1_ref[:]  # [TB, K]
+    g = g_ref[:]  # [TB, 1]
+    v = rows[:, :, 1:]
+    gx = g * vals  # [TB, F]
+    dv = gx[:, :, None] * (s1[:, None, :] - v * vals[:, :, None])  # [TB,F,K]
+    dw = gx[:, :, None]  # [TB, F, 1]
+    drows_ref[:] = jnp.concatenate([dw, dv], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fm_scores_pallas(rows: jax.Array, vals: jax.Array, interpret: bool = False):
+    """Forward: (scores [B], s1 [B, K]) from gathered rows."""
+    b, f, d = rows.shape
+    tb = _block_b(b)
+    grid = (b // tb,)
+    scores, s1 = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tb, f, d), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, d - 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1), rows.dtype),
+            jax.ShapeDtypeStruct((b, d - 1), rows.dtype),
+        ],
+        interpret=interpret,
+    )(rows, vals)
+    return scores[:, 0], s1
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fm_grad_pallas(
+    rows: jax.Array,
+    vals: jax.Array,
+    s1: jax.Array,
+    dscores: jax.Array,
+    interpret: bool = False,
+):
+    """Backward: per-occurrence row grads [B, F, D]."""
+    b, f, d = rows.shape
+    tb = _block_b(b)
+    grid = (b // tb,)
+    return pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tb, f, d), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, d - 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tb, f, d), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, f, d), rows.dtype),
+        interpret=interpret,
+    )(rows, vals, s1, dscores[:, None])

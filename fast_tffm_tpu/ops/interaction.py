@@ -1,0 +1,91 @@
+"""FM interaction op with custom VJP — dispatches jnp oracle or Pallas.
+
+``fm_interaction(rows, vals)`` computes per-example FM scores (without w0)
+from gathered table rows, differentiable w.r.t. ``rows`` only (feature
+values are data, not parameters).  The backward pass uses the closed-form
+FmGrad (SURVEY.md §3.4) instead of autodiff through the sum-square trick —
+one fused kernel instead of XLA's unfused chain, and the basis for the
+sparse row-update training path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from fast_tffm_tpu.ops import fm_pallas
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _scores_jnp(rows, vals):
+    w = rows[..., 0]
+    v = rows[..., 1:]
+    xv = v * vals[..., None]
+    s1 = jnp.sum(xv, axis=1)
+    s2 = jnp.sum(xv * xv, axis=1)
+    linear = jnp.sum(w * vals, axis=-1)
+    return linear + 0.5 * jnp.sum(s1 * s1 - s2, axis=-1), s1
+
+
+def _grads_jnp(rows, vals, s1, g):
+    v = rows[..., 1:]
+    gx = (g[:, None] * vals)[..., None]  # [B, F, 1]
+    dv = gx * (s1[:, None, :] - v * vals[..., None])
+    return jnp.concatenate([gx, dv], axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fm_interaction(rows, vals, use_pallas: bool = True):
+    scores, _ = _forward(rows, vals, use_pallas)
+    return scores
+
+
+def fm_interaction_sharded(rows, vals, use_pallas, mesh, data_axis: str):
+    """Mesh-aware wrapper: Mosaic kernels cannot be auto-partitioned by
+    GSPMD, so on a multi-device mesh the pallas path must run under
+    shard_map with the batch dimension sharded on the data axis (rows/vals
+    are replicated across the model axis — the gather already happened)."""
+    if not use_pallas:
+        return fm_interaction(rows, vals, False)
+    if mesh is None or mesh.size == 1:
+        return fm_interaction(rows, vals, use_pallas)
+    from jax.sharding import PartitionSpec as P
+
+    # check_vma=False: pallas_call out_shapes don't carry vma annotations.
+    return jax.shard_map(
+        lambda r, v: fm_interaction(r, v, use_pallas),
+        mesh=mesh,
+        in_specs=(P(data_axis, None, None), P(data_axis, None)),
+        out_specs=P(data_axis),
+        check_vma=False,
+    )(rows, vals)
+
+
+def _forward(rows, vals, use_pallas):
+    if use_pallas:
+        return fm_pallas.fm_scores_pallas(rows, vals,
+                                          interpret=_use_interpret())
+    return _scores_jnp(rows, vals)
+
+
+def _fwd(rows, vals, use_pallas):
+    scores, s1 = _forward(rows, vals, use_pallas)
+    return scores, (rows, vals, s1)
+
+
+def _bwd(use_pallas, res, g):
+    rows, vals, s1 = res
+    if use_pallas:
+        drows = fm_pallas.fm_grad_pallas(rows, vals, s1, g,
+                                         interpret=_use_interpret())
+    else:
+        drows = _grads_jnp(rows, vals, s1, g)
+    return drows, None  # no gradient w.r.t. vals
+
+
+fm_interaction.defvjp(_fwd, _bwd)

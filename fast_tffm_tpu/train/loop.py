@@ -29,6 +29,7 @@ from fast_tffm_tpu.data.pipeline import BatchPipeline
 from fast_tffm_tpu.models import fm
 from fast_tffm_tpu.parallel import mesh as mesh_lib
 from fast_tffm_tpu.train import checkpoint, metrics as metrics_lib
+from fast_tffm_tpu.train import sparse as sparse_lib
 from fast_tffm_tpu.train.optimizers import make_optimizer
 
 log = logging.getLogger(__name__)
@@ -67,7 +68,7 @@ def _metric_update(
 
 
 def make_train_step(cfg: FmConfig, optimizer):
-    """Returns step(state, batch) -> state, jit-ready."""
+    """Dense train step (optax): full-table optimizer update each step."""
 
     def step(state: TrainState, batch: Batch) -> TrainState:
         def loss_fn(params):
@@ -89,6 +90,25 @@ def make_train_step(cfg: FmConfig, optimizer):
         ms = _metric_update(
             state.metrics, aux["scores"], batch.labels, batch.weights,
             cfg.loss_type,
+        )
+        return TrainState(params, opt_state, ms, state.step + 1)
+
+    return step
+
+
+def make_sparse_train_step(cfg: FmConfig, mesh=None):
+    """Sparse train step: optimizer touches only the batch's rows
+    (train.sparse — the IndexedSlices path, SURVEY.md §3.2).  The mesh is
+    threaded through so the Pallas kernel runs under shard_map (Mosaic
+    kernels cannot be auto-partitioned by GSPMD)."""
+
+    def step(state: TrainState, batch: Batch) -> TrainState:
+        params, opt_state, scores = sparse_lib.sparse_step(
+            cfg, state.params, state.opt_state, batch,
+            mesh=mesh, data_axis=mesh_lib.DATA_AXIS,
+        )
+        ms = _metric_update(
+            state.metrics, scores, batch.labels, batch.weights, cfg.loss_type
         )
         return TrainState(params, opt_state, ms, state.step + 1)
 
@@ -147,7 +167,18 @@ class Trainer:
     def __init__(self, cfg: FmConfig, mesh=None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
-        self.optimizer = make_optimizer(cfg)
+        self.sparse = bool(cfg.sparse_update) and sparse_lib.supports_sparse(cfg)
+        if cfg.sparse_update and not self.sparse:
+            log.info(
+                "sparse_update unsupported for optimizer=%s l2_mode=%s; "
+                "using dense optax path", cfg.optimizer, cfg.l2_mode,
+            )
+        if self.sparse:
+            self.optimizer = None
+            self._opt_init_fn = partial(sparse_lib.init_sparse_opt_state, cfg)
+        else:
+            self.optimizer = make_optimizer(cfg)
+            self._opt_init_fn = self.optimizer.init
         if cfg.batch_size % self.mesh.shape[mesh_lib.DATA_AXIS] != 0:
             raise ValueError(
                 f"batch_size {cfg.batch_size} not divisible by data-mesh "
@@ -168,8 +199,13 @@ class Trainer:
         )
 
         state_sh = jax.tree.map(lambda x: x.sharding, self.state)
+        step_fn = (
+            make_sparse_train_step(cfg, self.mesh)
+            if self.sparse
+            else make_train_step(cfg, self.optimizer)
+        )
         self._train_step = jax.jit(
-            make_train_step(cfg, self.optimizer),
+            step_fn,
             in_shardings=(state_sh, self._batch_sh),
             out_shardings=state_sh,
             donate_argnums=0,
@@ -188,7 +224,7 @@ class Trainer:
         (SURVEY.md §7 hard-part 4: optimizer state never gathers)."""
         rep = NamedSharding(self.mesh, P())
         table_shape = params_template.table.shape
-        opt_shapes = jax.eval_shape(self.optimizer.init, params_template)
+        opt_shapes = jax.eval_shape(self._opt_init_fn, params_template)
         return jax.tree.map(
             lambda s: param_sh.table if s.shape == table_shape else rep,
             opt_shapes,
@@ -198,14 +234,14 @@ class Trainer:
         cfg = self.cfg
         template = _params_template(cfg, param_sh)
         opt_sh = self._opt_shardings(param_sh, template)
-        opt_init = jax.jit(self.optimizer.init, out_shardings=opt_sh)
+        opt_init = jax.jit(self._opt_init_fn, out_shardings=opt_sh)
         if checkpoint.exists(cfg.model_file):
             log.info("warm-starting from %s", cfg.model_file)
             params, self._restored_step = checkpoint.restore_params(
                 cfg.model_file, template
             )
             params = fm.FmParams(*params)
-            opt_shapes = jax.eval_shape(self.optimizer.init, template)
+            opt_shapes = jax.eval_shape(self._opt_init_fn, template)
             opt_template = jax.tree.map(
                 lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
                 opt_shapes,
