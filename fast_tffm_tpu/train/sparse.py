@@ -140,17 +140,26 @@ def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows):
     lr, l1, l2, beta = (
         cfg.learning_rate, cfg.ftrl_l1, cfg.ftrl_l2, cfg.ftrl_beta,
     )
-    # Rows: per-occurrence FTRL recursion on the touched rows (w_rows is the
-    # pre-update gather from sparse_step, reused — no second gather).
+    # Rows: FTRL recursion on the touched rows (w_rows is the pre-update
+    # gather from sparse_step, reused — no second gather).
+    #
+    # Duplicate-id care: z must receive each occurrence's gradient ONCE but
+    # the -sigma*w correction only once PER ROW.  Scatter-adding
+    # (g - sigma*w) per occurrence would apply -sigma*w k times for a row
+    # appearing k times — a positive feedback on w that diverges (w grows,
+    # |z| grows with it, the closed form returns a larger w, ...).  So:
+    # per-occurrence scatter-add of g, then a gather-modify-set for the
+    # sigma correction.  All quantities in the set are identical across
+    # duplicates (n_old/n_new/w pre-update are per-row), so the duplicate
+    # writes are well-defined.
     n_old_rows = opt.n.table[ids]
     n_table = opt.n.table.at[ids].add(g_rows * g_rows)
-    n_new_rows = n_table[ids]
-    sigma = (jnp.sqrt(n_new_rows) - jnp.sqrt(n_old_rows)) / lr
-    z_table = opt.z.table.at[ids].add(g_rows - sigma * w_rows)
-    z_rows = z_table[ids]
+    n_new_rows = n_table[ids]  # for dups: includes all occurrences' g^2
+    sigma = (jnp.sqrt(n_new_rows) - jnp.sqrt(n_old_rows)) / lr  # total sigma
+    zg_table = opt.z.table.at[ids].add(g_rows)
+    z_rows = zg_table[ids] - sigma * w_rows
+    z_table = zg_table.at[ids].set(z_rows)
     new_w_rows = _ftrl_solve(z_rows, n_new_rows, lr, l1, l2, beta)
-    # .at[].set with duplicate ids writes the same solved value (all dups
-    # see identical z/n), so the result is well-defined.
     table = params.table.at[ids].set(new_w_rows)
     # w0 (dense scalar path).
     n0_new = opt.n.w0 + dw0 * dw0

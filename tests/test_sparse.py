@@ -148,6 +148,41 @@ def test_sparse_duplicate_id_semantics(tmp_path):
         np.testing.assert_array_equal(after[r], before[r])
 
 
+def test_sparse_ftrl_matches_dense_on_unique_ids(tmp_path):
+    """Duplicate-free batches: sparse FTRL == dense optax-path FTRL."""
+    rng = np.random.default_rng(4)
+    kw = dict(optimizer="ftrl", ftrl_l1=0.001, ftrl_l2=0.001,
+              learning_rate=0.1)
+    cfg_s = _cfg(tmp_path, "fs", sparse_update=True, **kw)
+    cfg_d = _cfg(tmp_path, "fd", sparse_update=False, **kw)
+    batches = [_unique_batch(rng, cfg_s, cfg_s.batch_size) for _ in range(3)]
+    ts, td = Trainer(cfg_s), Trainer(cfg_d)
+    assert ts.sparse and not td.sparse
+    for b in batches:
+        ts.state = ts._train_step(ts.state, ts._put(b))
+        td.state = td._train_step(td.state, td._put(b))
+    np.testing.assert_allclose(
+        np.asarray(ts.state.params.table), np.asarray(td.state.params.table),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_sparse_ftrl_stable_under_heavy_duplicates(tmp_path):
+    """Regression: per-occurrence -sigma*w scatter double-counted duplicate
+    rows and diverged to NaN within a few hundred steps."""
+    rng = np.random.default_rng(5)
+    cfg = _cfg(tmp_path, "fdup", optimizer="ftrl", learning_rate=0.5,
+               vocabulary_size=50)  # tiny vocab -> heavy duplicates
+    t = Trainer(cfg)
+    for _ in range(150):
+        b = _dup_batch(rng, cfg, cfg.batch_size)
+        t.state = t._train_step(t.state, t._put(b))
+    table = np.asarray(t.state.params.table)
+    assert np.all(np.isfinite(table))
+    assert np.abs(table).max() < 10.0
+    assert np.isfinite(float(t.state.metrics.loss_sum))
+
+
 @pytest.mark.parametrize("d,m", [(4, 2), (1, 8)])
 def test_sparse_sharded_matches_single_device(tmp_path, d, m):
     rng = np.random.default_rng(3)
