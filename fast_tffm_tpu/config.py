@@ -104,6 +104,11 @@ class FmConfig:
     # rows in the batch. Falls back to dense when the optimizer/l2_mode
     # combination requires it (see train.sparse.supports_sparse).
     sparse_update: bool = True
+    # Fast ingest: read files as raw binary chunks, C++ line scan + parse,
+    # no Python string per line. Shuffling then happens at batch-group
+    # granularity instead of line granularity. Line path is used for
+    # weight_files or when the native parser is unavailable.
+    fast_ingest: bool = True
     # L2 mode: "batch" regularizes only the rows touched by the batch
     # (sparse-friendly); "full" regularizes the whole table (dense grads,
     # only sane for small vocabularies).
@@ -181,6 +186,7 @@ _KEYMAP = {
     "compute_dtype": ("compute_dtype", str),
     "use_pallas": ("use_pallas", _parse_bool),
     "sparse_update": ("sparse_update", _parse_bool),
+    "fast_ingest": ("fast_ingest", _parse_bool),
     "l2_mode": ("l2_mode", str),
 }
 

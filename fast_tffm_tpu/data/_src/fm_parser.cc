@@ -68,6 +68,102 @@ inline int64_t PyMod(int64_t x, int64_t m) {
   return r < 0 ? r + m : r;
 }
 
+// Fast integer parse of [s, e): full-token decimal with optional sign.
+// (strtoll is several times slower due to locale/errno handling.)
+inline bool ParseInt(const char* s, const char* e, int64_t* out) {
+  if (s >= e) return false;
+  bool neg = false;
+  if (*s == '+' || *s == '-') {
+    neg = (*s == '-');
+    ++s;
+  }
+  if (s >= e) return false;
+  uint64_t v = 0;
+  int digits = 0;
+  for (; s < e; ++s) {
+    char c = *s;
+    if (c < '0' || c > '9') return false;
+    v = v * 10 + (c - '0');
+    if (++digits > 18) return false;  // fields never this long
+  }
+  *out = neg ? -static_cast<int64_t>(v) : static_cast<int64_t>(v);
+  return true;
+}
+
+// Parses a decimal feature id of ANY length and reduces it mod m on the
+// fly, matching Python's arbitrary-precision int(token) % m exactly
+// (including the non-negative result for negative ids). Requires
+// m < 2^59 so r*10 + digit cannot overflow uint64.
+inline bool ParseIdMod(const char* s, const char* e, uint64_t m,
+                       int64_t* out) {
+  if (s >= e) return false;
+  bool neg = false;
+  if (*s == '+' || *s == '-') {
+    neg = (*s == '-');
+    ++s;
+  }
+  if (s >= e) return false;
+  uint64_t r = 0;
+  for (; s < e; ++s) {
+    char c = *s;
+    if (c < '0' || c > '9') return false;
+    r = (r * 10 + static_cast<uint64_t>(c - '0')) % m;
+  }
+  if (neg && r) r = m - r;
+  *out = static_cast<int64_t>(r);
+  return true;
+}
+
+const double kPow10[] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+                         1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+                         1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// Fast float parse of the full token [s, e). The fast path covers
+// [+-]digits[.digits] with <=15 significant digits — mantissa and power of
+// ten are then both exact doubles, so the single division is correctly
+// rounded and matches strtod (and Python's float()) bit-for-bit. Anything
+// else (exponents, inf/nan, long mantissas) falls back to strtof.
+inline bool ParseFloat(const char* s, const char* e, float* out) {
+  const char* p = s;
+  bool neg = false;
+  if (p < e && (*p == '+' || *p == '-')) {
+    neg = (*p == '-');
+    ++p;
+  }
+  uint64_t mant = 0;
+  int digits = 0, frac = 0;
+  bool any = false, dot = false, fast = true;
+  for (; p < e; ++p) {
+    char c = *p;
+    if (c >= '0' && c <= '9') {
+      if (digits < 15) {
+        mant = mant * 10 + (c - '0');
+        ++digits;
+        if (dot) ++frac;
+        any = true;
+      } else {
+        fast = false;
+        break;
+      }
+    } else if (c == '.' && !dot) {
+      dot = true;
+    } else {
+      fast = false;
+      break;
+    }
+  }
+  if (fast && any) {
+    double v = static_cast<double>(mant) / kPow10[frac];
+    *out = static_cast<float>(neg ? -v : v);
+    return true;
+  }
+  char* endp = nullptr;
+  float v = std::strtof(s, &endp);
+  if (endp != e || s == e) return false;
+  *out = v;
+  return true;
+}
+
 // Parses one line into row `row` of the outputs. Returns the number of
 // feature tokens dropped by max_features truncation; -1 on malformed input.
 int ParseLine(const Parser& p, const char* s, const char* end, int64_t row,
@@ -77,15 +173,16 @@ int ParseLine(const Parser& p, const char* s, const char* end, int64_t row,
   while (end > s && IsSpace(end[-1])) --end;
   if (s >= end || *s == '#') return 0;  // blank/comment: row stays zeroed
 
-  char* next = nullptr;
-  float label = std::strtof(s, &next);
+  const char* label_end = s;
+  while (label_end < end && !IsSpace(*label_end)) ++label_end;
+  float label;
   // The label token must be fully consumed ("1x" is malformed, like
   // Python float("1x")).
-  if (next == s || (next != end && !IsSpace(*next))) return -1;
+  if (!ParseFloat(s, label_end, &label)) return -1;
   if (label == -1.0f) label = 0.0f;  // accept {-1,1} label convention
   labels[row] = label;
 
-  const char* cur = next;
+  const char* cur = label_end;
   int count = 0;
   int dropped = 0;
   int32_t* row_ids = ids + row * p.max_features;
@@ -117,9 +214,7 @@ int ParseLine(const Parser& p, const char* s, const char* end, int64_t row,
     const char *val_s = nullptr, *val_e = nullptr;
     int64_t field = 0;
     if (c2) {  // field:id:val
-      char* fend = nullptr;
-      field = std::strtoll(tok, &fend, 10);
-      if (tok == c1 || fend != c1) return -1;  // empty/partial field
+      if (!ParseInt(tok, c1, &field)) return -1;  // empty/partial field
       id_s = c1 + 1;
       id_e = c2;
       val_s = c2 + 1;
@@ -141,17 +236,13 @@ int ParseLine(const Parser& p, const char* s, const char* end, int64_t row,
       fid = static_cast<int64_t>(Murmur64(id_s, id_e - id_s) %
                                  p.vocabulary_size);
     } else {
-      char* iend = nullptr;
-      int64_t raw = std::strtoll(id_s, &iend, 10);
-      // int("") raises in Python: require a nonempty, fully-consumed id.
-      if (id_s == id_e || iend != id_e) return -1;
-      fid = PyMod(raw, static_cast<int64_t>(p.vocabulary_size));
+      // int("") raises in Python: ParseIdMod rejects empty/partial ids,
+      // and handles ids of any digit length (Python-int parity).
+      if (!ParseIdMod(id_s, id_e, p.vocabulary_size, &fid)) return -1;
     }
     float v = 1.0f;
     if (val_s) {
-      char* vend = nullptr;
-      v = std::strtof(val_s, &vend);
-      if (val_s == val_e || vend != val_e) return -1;  // float("") raises
+      if (!ParseFloat(val_s, val_e, &v)) return -1;  // float("") raises
     }
     if (p.field_num > 0) field = PyMod(field, p.field_num);
 
@@ -169,10 +260,56 @@ int ParseLine(const Parser& p, const char* s, const char* end, int64_t row,
 
 }  // namespace
 
+// Shared parallel harness for the batch entry points: splits [0, n_lines)
+// across the parser's threads, aggregates truncation counts, and tracks
+// the first malformed line. per_line(i, local_dropped) returns false on
+// malformed input. Returns total dropped, or -(first_bad_index + 1).
+template <typename F>
+int64_t RunLines(const Parser& p, int64_t n_lines, F&& per_line) {
+  std::atomic<int64_t> dropped{0};
+  std::atomic<int64_t> first_bad{INT64_MAX};
+
+  auto work = [&](int64_t begin, int64_t stop) {
+    int64_t local_dropped = 0;
+    for (int64_t i = begin; i < stop; ++i) {
+      if (!per_line(i, &local_dropped)) {
+        int64_t cur = first_bad.load(std::memory_order_relaxed);
+        while (i < cur &&
+               !first_bad.compare_exchange_weak(cur, i,
+                                                std::memory_order_relaxed)) {
+        }
+        break;
+      }
+    }
+    dropped.fetch_add(local_dropped, std::memory_order_relaxed);
+  };
+
+  int nt = p.num_threads;
+  if (nt <= 1 || n_lines < 2 * nt) {
+    work(0, n_lines);
+  } else {
+    std::vector<std::thread> threads;
+    int64_t chunk = (n_lines + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      int64_t b = t * chunk;
+      int64_t e = b + chunk < n_lines ? b + chunk : n_lines;
+      if (b >= e) break;
+      threads.emplace_back(work, b, e);
+    }
+    for (auto& th : threads) th.join();
+  }
+  int64_t bad = first_bad.load();
+  if (bad != INT64_MAX) return -(bad + 1);
+  return dropped.load();
+}
+
 extern "C" {
 
 void* fm_parser_create(uint64_t vocabulary_size, int max_features,
                        int hash_feature_id, int field_num, int num_threads) {
+  if (vocabulary_size == 0 || vocabulary_size >= (1ULL << 59)) {
+    return nullptr;  // ParseIdMod requires m < 2^59 (r*10+9 in uint64)
+  }
   Parser* p = new Parser();
   p->vocabulary_size = vocabulary_size;
   p->max_features = max_features;
@@ -195,50 +332,67 @@ int64_t fm_parser_parse(void* handle, const char* buf,
                         int32_t* fields, float* weights,
                         const float* weights_in) {
   const Parser& p = *static_cast<Parser*>(handle);
-  std::atomic<int64_t> dropped{0};
-  // First malformed line index, or INT64_MAX if none (min across threads).
-  std::atomic<int64_t> first_bad{INT64_MAX};
-
-  auto work = [&](int64_t begin, int64_t stop) {
-    int64_t local_dropped = 0;
-    for (int64_t i = begin; i < stop; ++i) {
-      int d = ParseLine(p, buf + offsets[i], buf + offsets[i + 1], i, labels,
-                        ids, vals, fields);
-      if (d < 0) {
-        int64_t cur = first_bad.load(std::memory_order_relaxed);
-        while (i < cur &&
-               !first_bad.compare_exchange_weak(cur, i,
-                                                std::memory_order_relaxed)) {
-        }
-        return;
-      }
-      local_dropped += d;
-      weights[i] = weights_in ? weights_in[i] : 1.0f;
-    }
-    dropped.fetch_add(local_dropped, std::memory_order_relaxed);
-  };
-
-  int nt = p.num_threads;
-  if (nt <= 1 || n_lines < 2 * nt) {
-    work(0, n_lines);
-  } else {
-    std::vector<std::thread> threads;
-    int64_t chunk = (n_lines + nt - 1) / nt;
-    for (int t = 0; t < nt; ++t) {
-      int64_t b = t * chunk;
-      int64_t e = b + chunk < n_lines ? b + chunk : n_lines;
-      if (b >= e) break;
-      threads.emplace_back(work, b, e);
-    }
-    for (auto& th : threads) th.join();
-  }
-  int64_t bad = first_bad.load();
-  if (bad != INT64_MAX) return -(bad + 1);  // -(line_index + 1)
-  return dropped.load();
+  return RunLines(p, n_lines, [&](int64_t i, int64_t* local_dropped) {
+    int d = ParseLine(p, buf + offsets[i], buf + offsets[i + 1], i, labels,
+                      ids, vals, fields);
+    if (d < 0) return false;
+    *local_dropped += d;
+    weights[i] = weights_in ? weights_in[i] : 1.0f;
+    return true;
+  });
 }
 
 uint64_t fm_parser_murmur64(const char* data, int64_t len) {
   return Murmur64(data, len);
+}
+
+// Scans buf for line-start offsets (byte after each '\n', plus offset 0).
+// Writes up to max_out offsets; returns the number found (may exceed
+// max_out to signal the caller to grow its buffer). The caller derives
+// line ends from the next start (ParseLine trims the trailing newline).
+int64_t fm_parser_find_lines(const char* buf, int64_t len, int64_t* out,
+                             int64_t max_out) {
+  int64_t count = 0;
+  if (len <= 0) return 0;
+  if (count < max_out) out[count] = 0;
+  ++count;
+  const char* p = buf;
+  const char* end = buf + len;
+  while ((p = static_cast<const char*>(memchr(p, '\n', end - p)))) {
+    ++p;
+    if (p >= end) break;  // trailing newline: no new line starts after it
+    if (count < max_out) out[count] = p - buf;
+    ++count;
+  }
+  return count;
+}
+
+// Like fm_parser_parse but marks blank/comment lines with weight 0 (the
+// raw-chunk path has no Python-side blank filtering). Lines that parse get
+// weight weights_in[i] (or 1.0). Same return convention.
+int64_t fm_parser_parse_raw(void* handle, const char* buf,
+                            const int64_t* offsets, int64_t n_lines,
+                            float* labels, int32_t* ids, float* vals,
+                            int32_t* fields, float* weights,
+                            const float* weights_in) {
+  const Parser& p = *static_cast<Parser*>(handle);
+  return RunLines(p, n_lines, [&](int64_t i, int64_t* local_dropped) {
+    const char* s = buf + offsets[i];
+    const char* e = buf + offsets[i + 1];
+    // Blank/comment lines become weight-0 rows (the raw-chunk path has no
+    // Python-side blank filtering); detection mirrors ParseLine's trim.
+    const char* t = s;
+    while (t < e && IsSpace(*t)) ++t;
+    if (t >= e || *t == '#') {
+      weights[i] = 0.0f;
+      return true;
+    }
+    int d = ParseLine(p, s, e, i, labels, ids, vals, fields);
+    if (d < 0) return false;
+    *local_dropped += d;
+    weights[i] = weights_in ? weights_in[i] : 1.0f;
+    return true;
+  });
 }
 
 }  // extern "C"

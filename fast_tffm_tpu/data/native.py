@@ -68,8 +68,36 @@ def _load() -> ctypes.CDLL:
     ]
     lib.fm_parser_murmur64.restype = ctypes.c_uint64
     lib.fm_parser_murmur64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.fm_parser_find_lines.restype = ctypes.c_int64
+    lib.fm_parser_find_lines.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+    ]
+    lib.fm_parser_parse_raw.restype = ctypes.c_int64
+    lib.fm_parser_parse_raw.argtypes = lib.fm_parser_parse.argtypes
     _lib = lib
     return lib
+
+
+def find_line_offsets(
+    buf: bytes, length: Optional[int] = None, guess: Optional[int] = None
+) -> np.ndarray:
+    """Line-start offsets in buf[:length] (C++ memchr scan, no copies).
+
+    ``guess`` is the expected line count (callers streaming a file pass the
+    previous buffer's count — line density is stable, avoiding a rescan).
+    """
+    lib = _load()
+    n_len = len(buf) if length is None else length
+    guess = max(16, n_len // 64 if guess is None else guess)
+    while True:
+        out = np.empty((guess,), np.int64)
+        n = lib.fm_parser_find_lines(buf, n_len, out, guess)
+        if n <= guess:
+            return out[:n]
+        guess = n
 
 
 def murmur64_native(data: bytes) -> int:
@@ -94,6 +122,11 @@ class NativeParser:
             vocabulary_size, max_features, int(hash_feature_id), field_num,
             num_threads,
         )
+        if not self._handle:
+            raise ValueError(
+                f"vocabulary_size {vocabulary_size} out of range (must be "
+                "in [1, 2^59) for the native parser)"
+            )
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -140,6 +173,38 @@ class NativeParser:
             bad = -int(dropped) - 1
             raise ValueError(
                 f"malformed libsvm input at batch line {bad}: {lines[bad]!r}"
+            )
+        if dropped:
+            self.truncated_features += int(dropped)
+        return Batch(labels, ids, vals, fields, w)
+
+    def parse_raw(
+        self,
+        buf: bytes,
+        offsets: np.ndarray,  # [n+1] int64: line starts + end-of-last-line
+        batch_size: int,
+    ) -> Batch:
+        """Zero-copy fast path: parse lines straight out of a raw file
+        chunk (no Python string per line). Blank/comment lines become
+        weight-0 rows."""
+        n = len(offsets) - 1
+        if n > batch_size:
+            raise ValueError(f"{n} lines > batch_size {batch_size}")
+        offsets = np.ascontiguousarray(offsets, np.int64)
+        labels = np.zeros((batch_size,), np.float32)
+        ids = np.zeros((batch_size, self.max_features), np.int32)
+        vals = np.zeros((batch_size, self.max_features), np.float32)
+        fields = np.zeros((batch_size, self.max_features), np.int32)
+        w = np.zeros((batch_size,), np.float32)
+        dropped = self._lib.fm_parser_parse_raw(
+            self._handle, buf, offsets, n, labels, ids, vals, fields, w,
+            None,
+        )
+        if dropped < 0:
+            bad = -int(dropped) - 1
+            text = buf[offsets[bad]:offsets[bad + 1]]
+            raise ValueError(
+                f"malformed libsvm input at chunk line {bad}: {text!r}"
             )
         if dropped:
             self.truncated_features += int(dropped)

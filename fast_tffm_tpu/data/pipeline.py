@@ -27,6 +27,10 @@ from fast_tffm_tpu.data import libsvm
 
 log = logging.getLogger(__name__)
 
+# Raw-chunk read size for the fast ingest path. Each shuffled group keeps
+# its source chunk alive, so this also bounds shuffle-buffer memory.
+_CHUNK_BYTES = 4 << 20
+
 _SENTINEL = object()
 
 
@@ -92,6 +96,90 @@ def _shuffled(
     yield from buf
 
 
+def _raw_chunk_stream(files: Sequence[str], chunk_bytes: int):
+    """Binary chunks of all files as ONE stream; a '\\n' is injected at a
+    file boundary when the file lacks a trailing newline, so lines never
+    merge across files and batches pack across files like the line path."""
+    for path in files:
+        last = b"\n"
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(chunk_bytes)
+                if not chunk:
+                    break
+                last = chunk[-1:]
+                yield chunk
+        if last != b"\n":
+            yield b"\n"
+
+
+def _iter_raw_groups(
+    files: Sequence[str], batch_size: int, chunk_bytes: int = _CHUNK_BYTES
+):
+    """Yield (buf, offsets[n+1]) groups of <= batch_size raw text lines.
+
+    The fast ingest path: files are read in binary chunks, line starts
+    found by the C++ scanner, and groups reference the chunk buffer
+    directly — no Python string is ever created per line.  Chunks are
+    accumulated (newline counts are cheap) and joined ONCE per buffer so
+    oversized batches don't cause quadratic re-copies; leftover lines are
+    carried into the next buffer, including across file boundaries.
+    """
+    from fast_tffm_tpu.data import native
+
+    stream = _raw_chunk_stream(files, chunk_bytes)
+    pending = b""
+    at_eof = False
+    guess = 0  # line-count guess carried between buffers (stable density)
+    while not at_eof:
+        parts = [pending]
+        nls = pending.count(b"\n")
+        # Gather at least one full group's worth of complete lines.
+        while nls < batch_size:
+            chunk = next(stream, None)
+            if chunk is None:
+                at_eof = True
+                break
+            parts.append(chunk)
+            nls += chunk.count(b"\n")
+        buf = b"".join(parts)
+        pending = b""
+        if at_eof:
+            buf_end = len(buf)
+        else:
+            buf_end = buf.rfind(b"\n") + 1  # >=1: nls >= batch_size >= 1
+        starts = native.find_line_offsets(buf, buf_end, guess=guess or None)
+        n_lines = len(starts)
+        guess = n_lines + 2
+        if n_lines == 0:
+            if at_eof:
+                return
+            pending = buf
+            continue
+        ends = np.append(starts[1:], buf_end)
+        if at_eof:
+            n_keep = n_lines  # flush everything, partial group included
+        else:
+            n_keep = (n_lines // batch_size) * batch_size
+            leftover_start = (
+                int(starts[n_keep]) if n_keep < n_lines else buf_end
+            )
+            pending = buf[leftover_start:]
+        for i in range(0, n_keep, batch_size):
+            j = min(i + batch_size, n_keep)
+            offsets = np.empty((j - i + 1,), np.int64)
+            offsets[:-1] = starts[i:j]
+            offsets[-1] = ends[j - 1]
+            yield (buf, offsets)
+
+
+def _item_len(item) -> int:
+    """Number of lines in a work item (line chunk or raw group)."""
+    if isinstance(item, tuple):
+        return len(item[1]) - 1
+    return len(item)
+
+
 class BatchPipeline:
     """Background-threaded parse/batch pipeline.
 
@@ -125,7 +213,15 @@ class BatchPipeline:
         # ordered=True forces one parser thread so batches come out in
         # input order (the predict path needs score/line alignment).
         self.ordered = ordered
-        self._parser = _make_parser(cfg)
+        self._native, self._parser = _make_parser(cfg)
+        # Fast ingest: raw binary chunks + C++ line scan, no Python string
+        # per line. Requires the native parser; weight_files need per-line
+        # pairing so they stay on the line path. Shuffling happens at
+        # batch-group granularity here (the line path shuffles lines).
+        self._raw = (
+            cfg.fast_ingest and self._native is not None
+            and not self.weight_files
+        )
 
     def __iter__(self) -> Iterator[libsvm.Batch]:
         cfg = self.cfg
@@ -144,24 +240,40 @@ class BatchPipeline:
                     continue
             return False
 
+        def _line_chunks(rng):
+            """Line path: line-level shuffle, then fixed-size chunks."""
+            it = iter_lines(self.files, self.weight_files)
+            if self.shuffle:
+                it = _shuffled(it, max(1, cfg.shuffle_buffer), rng)
+            chunk: list[tuple[str, float]] = []
+            for item in it:
+                chunk.append(item)
+                if len(chunk) == cfg.batch_size:
+                    yield chunk
+                    chunk = []
+            if chunk:
+                yield chunk
+
         def reader():
             try:
                 for epoch in range(self.epochs):
-                    it = iter_lines(self.files, self.weight_files)
-                    if self.shuffle:
-                        rng = random.Random(self.seed + epoch)
-                        it = _shuffled(it, max(1, cfg.shuffle_buffer), rng)
-                    chunk: list[tuple[str, float]] = []
+                    rng = random.Random(self.seed + epoch)
+                    if self._raw:
+                        it = _iter_raw_groups(self.files, cfg.batch_size)
+                        if self.shuffle:  # group-granularity shuffle
+                            buffer = max(
+                                1, cfg.shuffle_buffer // cfg.batch_size
+                            )
+                            it = _shuffled(it, buffer, rng)
+                    else:
+                        it = _line_chunks(rng)
                     for item in it:
                         if stop.is_set():
                             return
-                        chunk.append(item)
-                        if len(chunk) == cfg.batch_size:
-                            if not put_checked(work, chunk):
-                                return
-                            chunk = []
-                    if chunk and not self.drop_remainder:
-                        put_checked(work, chunk)
+                        if self.drop_remainder and _item_len(item) < cfg.batch_size:
+                            continue
+                        if not put_checked(work, item):
+                            return
             except BaseException as e:  # surfaces in the consumer
                 put_checked(out, _Error(e))
             finally:
@@ -178,9 +290,14 @@ class BatchPipeline:
                     put_checked(out, _SENTINEL)
                     return
                 try:
-                    lines = [c[0] for c in chunk]
-                    weights = [c[1] for c in chunk]
-                    batch = self._parser(lines, weights)
+                    if isinstance(chunk, tuple):  # raw (buf, offsets) group
+                        batch = self._native.parse_raw(
+                            chunk[0], chunk[1], cfg.batch_size
+                        )
+                    else:
+                        lines = [c[0] for c in chunk]
+                        weights = [c[1] for c in chunk]
+                        batch = self._parser(lines, weights)
                 except BaseException as e:
                     put_checked(out, _Error(e))
                     continue
@@ -218,7 +335,7 @@ class BatchPipeline:
 
 
 def _make_parser(cfg: FmConfig):
-    """Returns lines, weights -> Batch, preferring the C++ parser."""
+    """Returns (native_parser_or_None, (lines, weights) -> Batch)."""
     native = None
     try:
         from fast_tffm_tpu.data import native as _native
@@ -238,7 +355,7 @@ def _make_parser(cfg: FmConfig):
         def parse(lines, weights):
             return native.parse_batch(lines, cfg.batch_size, weights)
 
-        return parse
+        return native, parse
 
     def parse_py(lines, weights):
         examples = libsvm.parse_lines(
@@ -248,4 +365,4 @@ def _make_parser(cfg: FmConfig):
             examples, cfg.batch_size, cfg.max_features, weights
         )
 
-    return parse_py
+    return None, parse_py

@@ -101,6 +101,25 @@ def test_native_malformed_beyond_truncation_still_raises(built):
         parser.parse_batch(["1 1:1 2:1 3:1 bad:"], batch_size=1)
 
 
+def test_native_long_ids_match_python_int_semantics(built):
+    """Ids longer than int64 must still mod like Python's unbounded int."""
+    parser = native.NativeParser(1000, 4, num_threads=1)
+    cases = [
+        "1 9223372036854775806:1.0",        # near int64 max
+        "1 99999999999999999999999999:1.0",  # way past int64
+        "1 -7:1.0",                          # negative id, Python-mod
+    ]
+    got = parser.parse_batch(cases, batch_size=3)
+    exs = libsvm.parse_lines(cases, 1000)
+    want = libsvm.make_batch(exs, 3, 4)
+    np.testing.assert_array_equal(got.ids, want.ids)
+
+
+def test_native_vocab_size_bounds(built):
+    with pytest.raises(ValueError, match="out of range"):
+        native.NativeParser(1 << 60, 4)
+
+
 def test_native_empty_hash_id_matches_oracle(built):
     """Hash mode hashes the empty string (Python murmur64(b'') is valid)."""
     parser = native.NativeParser(100, 4, hash_feature_id=True, num_threads=1)

@@ -113,6 +113,81 @@ def test_pipeline_raises_on_missing_weight_file(data_files):
         list(pipe)
 
 
+def test_raw_groups_cross_chunk_boundaries(tmp_path):
+    """Fast-ingest chunking must carry partial lines/groups across reads."""
+    from fast_tffm_tpu.data.pipeline import _iter_raw_groups
+    from fast_tffm_tpu.data import native
+
+    path = tmp_path / "d.libsvm"
+    lines = [f"1 {i}:1.0" for i in range(257)]
+    path.write_text("\n".join(lines) + "\n")
+    # Absurdly small chunk size forces many boundary crossings.
+    groups = list(_iter_raw_groups([str(path)], batch_size=10, chunk_bytes=17))
+    parser = native.NativeParser(1000, 4, num_threads=1)
+    got = []
+    for buf, off in groups:
+        assert len(off) - 1 <= 10
+        b = parser.parse_raw(buf, off, 10)
+        got.extend(b.ids[b.vals > 0].tolist())
+    assert got == list(range(257))
+
+
+def test_raw_groups_pack_across_file_boundaries(tmp_path):
+    """Batches pack across files (like the line path); a missing trailing
+    newline at a file boundary must not merge lines."""
+    from fast_tffm_tpu.data.pipeline import _iter_raw_groups
+    from fast_tffm_tpu.data import native
+
+    a = tmp_path / "a.libsvm"
+    a.write_bytes(b"1 0:1.0\n1 1:1.0\n1 2:1.0")  # no trailing newline
+    b = tmp_path / "b.libsvm"
+    b.write_bytes(b"1 3:1.0\n1 4:1.0\n1 5:1.0\n1 6:1.0\n")
+    groups = list(_iter_raw_groups([str(a), str(b)], batch_size=4))
+    parser = native.NativeParser(1000, 4, num_threads=1)
+    batches = [parser.parse_raw(buf, off, 4) for buf, off in groups]
+    # 7 lines -> one full group of 4 (spanning the file boundary) + tail 3.
+    assert [int((bb.weights > 0).sum()) for bb in batches] == [4, 3]
+    got = [i for bb in batches for i in bb.ids[bb.vals > 0].tolist()]
+    assert got == list(range(7))
+
+
+def test_raw_parse_blank_and_comment_weight_zero(tmp_path):
+    from fast_tffm_tpu.data import native
+
+    buf = b"1 5:1.0\n\n# comment\n0 7:2.0\n"
+    starts = native.find_line_offsets(buf)
+    offsets = np.append(starts, len(buf))
+    parser = native.NativeParser(100, 4, num_threads=1)
+    b = parser.parse_raw(buf, offsets, 8)
+    np.testing.assert_array_equal(b.weights[:4], [1, 0, 0, 1])
+    assert b.ids[0, 0] == 5 and b.ids[3, 0] == 7
+
+
+def test_raw_pipeline_matches_line_pipeline(tmp_path):
+    """Fast ingest and line path parse identical batches (unshuffled)."""
+    path = tmp_path / "d.libsvm"
+    rng = np.random.default_rng(0)
+    with open(path, "w") as f:
+        for _ in range(100):
+            toks = " ".join(
+                f"{rng.integers(0, 99)}:{rng.uniform(0, 2):.4f}"
+                for _ in range(rng.integers(1, 5))
+            )
+            f.write(f"{rng.integers(0, 2)} {toks}\n")
+    cfg_fast = _cfg(fast_ingest=True)
+    cfg_line = _cfg(fast_ingest=False)
+    fast = list(BatchPipeline([str(path)], cfg_fast, epochs=1, shuffle=False,
+                              ordered=True))
+    line = list(BatchPipeline([str(path)], cfg_line, epochs=1, shuffle=False,
+                              ordered=True))
+    assert len(fast) == len(line)
+    for bf, bl in zip(fast, line):
+        np.testing.assert_array_equal(bf.ids, bl.ids)
+        np.testing.assert_array_equal(bf.vals, bl.vals)
+        np.testing.assert_array_equal(bf.labels, bl.labels)
+        np.testing.assert_array_equal(bf.weights, bl.weights)
+
+
 def test_pipeline_drop_remainder(data_files):
     pipe = BatchPipeline(
         data_files, _cfg(), epochs=1, shuffle=False, drop_remainder=True
