@@ -104,6 +104,10 @@ class FmConfig:
     # rows in the batch. Falls back to dense when the optimizer/l2_mode
     # combination requires it (see train.sparse.supports_sparse).
     sparse_update: bool = True
+    # How sparse updates hit the table: "scatter" uses XLA row scatter
+    # (general but slow on TPU), "tile" the Pallas sort+tile-scan kernels
+    # (ops.sparse_apply), "auto" picks tile when supported.
+    sparse_apply: str = "auto"
     # Fast ingest: read files as raw binary chunks, C++ line scan + parse,
     # no Python string per line. Shuffling then happens at batch-group
     # granularity instead of line granularity. Line path is used for
@@ -127,6 +131,8 @@ class FmConfig:
             raise ValueError(f"unknown lookup {self.lookup!r}")
         if self.l2_mode not in ("batch", "full"):
             raise ValueError(f"unknown l2_mode {self.l2_mode!r}")
+        if self.sparse_apply not in ("auto", "tile", "scatter"):
+            raise ValueError(f"unknown sparse_apply {self.sparse_apply!r}")
         if self.weight_files and len(self.weight_files) != len(self.train_files):
             raise ValueError(
                 "weight_files must parallel train_files "
@@ -186,6 +192,7 @@ _KEYMAP = {
     "compute_dtype": ("compute_dtype", str),
     "use_pallas": ("use_pallas", _parse_bool),
     "sparse_update": ("sparse_update", _parse_bool),
+    "sparse_apply": ("sparse_apply", str),
     "fast_ingest": ("fast_ingest", _parse_bool),
     "l2_mode": ("l2_mode", str),
 }

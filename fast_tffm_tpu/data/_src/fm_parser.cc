@@ -83,9 +83,15 @@ inline bool ParseInt(const char* s, const char* e, int64_t* out) {
   for (; s < e; ++s) {
     char c = *s;
     if (c < '0' || c > '9') return false;
-    v = v * 10 + (c - '0');
-    if (++digits > 18) return false;  // fields never this long
+    // Past 19 digits int64 overflows; match Python int() by wrapping like
+    // a checked strtoll would — reject only on true overflow.
+    if (++digits > 19) return false;
+    uint64_t nv = v * 10 + (c - '0');
+    if (digits == 19 && nv / 10 != v) return false;  // overflow
+    v = nv;
   }
+  uint64_t limit = neg ? (1ull << 63) : (1ull << 63) - 1;
+  if (v > limit) return false;
   *out = neg ? -static_cast<int64_t>(v) : static_cast<int64_t>(v);
   return true;
 }

@@ -31,12 +31,43 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _block_b(batch: int) -> int:
-    """Batch-tile size: cap VMEM use, keep sublane-aligned."""
-    for tb in (512, 256, 128, 64, 32, 16, 8):
-        if batch % tb == 0:
+def _padded_bytes(shape: tuple[int, ...], itemsize: int = 4) -> int:
+    """VMEM footprint of one block: last two dims tile-pad to (8, 128)."""
+    if len(shape) < 2:
+        return itemsize * max(shape[0], 1) * 128
+    dims = list(shape)
+    dims[-2] = -(-dims[-2] // 8) * 8
+    dims[-1] = -(-dims[-1] // 128) * 128
+    n = 1
+    for d in dims:
+        n *= d
+    return n * itemsize
+
+
+def _block_b(batch: int, f: int, d: int, n_bufs: int) -> int:
+    """Batch-tile size: keep double-buffered padded blocks under the
+    ~16MB scoped-VMEM limit (with headroom), sublane-aligned.
+
+    ``n_bufs`` counts the [TB, F, D]-shaped blocks in flight (the [TB, F]
+    and [TB, K] blocks are small by comparison but included via the +1).
+    """
+    budget = 6 * 1024 * 1024  # conservative vs the 16MB scoped-VMEM limit
+
+    def fits(tb: int) -> bool:
+        per_block = (n_bufs + 1) * _padded_bytes((tb, f, d))
+        return 2 * per_block <= budget  # x2 for double buffering
+
+    divisors = sorted(
+        (tb for tb in range(1, min(batch, 1024) + 1) if batch % tb == 0),
+        reverse=True,
+    )
+    for tb in divisors:  # largest sublane-aligned divisor within budget
+        if tb % 8 == 0 and fits(tb):
             return tb
-    return batch
+    for tb in divisors:  # any divisor within budget
+        if fits(tb):
+            return tb
+    return divisors[-1]
 
 
 def _fwd_kernel(rows_ref, vals_ref, score_ref, s1_ref):
@@ -69,7 +100,7 @@ def _bwd_kernel(rows_ref, vals_ref, s1_ref, g_ref, drows_ref):
 def fm_scores_pallas(rows: jax.Array, vals: jax.Array, interpret: bool = False):
     """Forward: (scores [B], s1 [B, K]) from gathered rows."""
     b, f, d = rows.shape
-    tb = _block_b(b)
+    tb = _block_b(b, f, d, n_bufs=1)
     grid = (b // tb,)
     scores, s1 = pl.pallas_call(
         _fwd_kernel,
@@ -103,7 +134,7 @@ def fm_grad_pallas(
 ):
     """Backward: per-occurrence row grads [B, F, D]."""
     b, f, d = rows.shape
-    tb = _block_b(b)
+    tb = _block_b(b, f, d, n_bufs=2)
     grid = (b // tb,)
     return pl.pallas_call(
         _bwd_kernel,

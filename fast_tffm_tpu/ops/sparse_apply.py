@@ -1,0 +1,313 @@
+"""Tile-scan sparse optimizer apply — Pallas TPU replacement for row scatter.
+
+The reference applies sparse updates with TF's SparseApplyAdagrad/-Ftrl over
+``IndexedSlices`` (SURVEY.md §2 #8, §3.2): per step it updates only the rows
+the batch touched.  The direct XLA translation (``table.at[ids].add``) is
+correct but slow on TPU: a scatter of N≈640k rows costs ~73ms on v5e — the
+scatter unit processes rows serially — and sparse Adagrad needs *three* such
+passes (acc scatter-add, acc re-gather, table scatter).
+
+This module replaces all of it with a sort + two Pallas kernels, turning the
+random-access scatter into sequential streams and MXU matmuls:
+
+1. XLA prep: sort occurrence ids (carrying a permutation), mark segment
+   starts, prefix-sum to get each occurrence's *unique-row position* (upos).
+2. ``K1`` (dedup): grid over chunks of C sorted occurrences.  A one-hot
+   [C, C] matmul segment-sums each chunk's payload ``(g, g², lrow·last)``
+   per unique id; a VMEM carry accumulates segments that span chunk
+   boundaries (hot features can span many chunks); each chunk DMAs its
+   window of unique rows to HBM at dynamic offset upos_start — last writer
+   per row holds the complete sum.
+3. ``K2`` (apply): grid over table tiles of R rows.  Streams the table (and
+   optimizer-state tables) tile by tile, DMAs in the ≤R unique entries that
+   land in the tile (a tile of R rows can hold at most R unique ids — the
+   bound that makes the window exact), places them with a one-hot [R, R]
+   matmul, and applies the optimizer formula on the whole tile in VPU.
+
+Per step this costs one pass over the table (streaming, bandwidth-bound)
+plus ~1ms of MXU placement matmuls, independent of duplicate structure —
+measured ~10x faster than the XLA scatter path at Criteo shapes (V=2^22,
+B=16k, F=39) and exact to ~1e-6 relative (one-hot matmuls run as two-pass
+bf16 hi/lo splits, keeping ~f32 precision).
+
+Semantics match train.sparse exactly: per-occurrence g² accumulation,
+shared post-update denominator for duplicates (Adagrad), single -sigma*w
+correction per row (FTRL).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+CHUNK = 512  # C: sorted occurrences per K1 grid step
+TILE = 256  # R: table rows per K2 grid step (also the K2 window size)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def supports_tile(vocab: int, optimizer: str) -> bool:
+    return vocab % TILE == 0 and vocab >= TILE and optimizer in (
+        "adagrad", "ftrl", "sgd",
+    )
+
+
+# ---------------------------------------------------------------- K1: dedup
+
+
+def _k1_kernel(starts_ref, firsts_ref, ends_ref, payload_ref, upos_ref,
+               out_ref, u_vmem, carry_ref, sem, *, chunk, lanes):
+    j = pl.program_id(0)
+    upos_s = starts_ref[j]
+    payload = payload_ref[...]  # [C, L] f32
+    l = upos_ref[...] - upos_s  # [1, C] local segment index, in [0, C)
+    # onehotT[s, i] = (l[i] == s): segment s on sublanes, occurrence i on
+    # lanes — built directly in the orientation the matmul wants.
+    s_iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
+    oh = (jnp.broadcast_to(l, (chunk, chunk)) == s_iota).astype(jnp.bfloat16)
+    # Segment-sum on the MXU.  f32 payload exactness via bf16 hi/lo split:
+    # hi rounds to bf16, lo carries the residual; both accumulate in f32.
+    p_hi = payload.astype(jnp.bfloat16)
+    p_lo = (payload - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    u_local = (
+        jax.lax.dot(oh, p_hi, preferred_element_type=jnp.float32)
+        + jax.lax.dot(oh, p_lo, preferred_element_type=jnp.float32)
+    )  # [C, L]
+    # Segment spanning in from the previous chunk: add its partial sums.
+    continues = (firsts_ref[j] == 0) & (j > 0)
+    u_local = u_local.at[0:1, :].add(
+        jnp.where(continues, carry_ref[0:1, :], 0.0)
+    )
+    # Segment spanning out into the next chunk: move it to the carry and
+    # write a zero — the chunk holding the segment's last occurrence is the
+    # last writer of that row and will hold the complete sum.
+    l_last = ends_ref[j] - upos_s
+    cont_next = firsts_ref[j + 1] == 0
+    last_row = jax.lax.dynamic_slice(u_local, (l_last, 0), (1, lanes))
+    carry_ref[...] = jnp.where(cont_next, last_row, 0.0).repeat(8, 0)
+    u_local = jax.lax.dynamic_update_slice(
+        u_local,
+        jnp.where(cont_next, jnp.zeros((1, lanes), jnp.float32), last_row),
+        (l_last, 0),
+    )
+    u_vmem[...] = u_local
+    cp = pltpu.make_async_copy(u_vmem, out_ref.at[pl.ds(upos_s, chunk)], sem)
+    cp.start()
+    cp.wait()
+
+
+def _k1_dedup(payload, upos, starts, firsts, ends, n_out):
+    n, lanes = payload.shape
+    chunk = CHUNK
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n // chunk,),
+        in_specs=[
+            pl.BlockSpec((chunk, lanes), lambda j, *_: (j, 0)),
+            pl.BlockSpec((1, chunk), lambda j, *_: (0, j)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((chunk, lanes), jnp.float32),
+            pltpu.VMEM((8, lanes), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_k1_kernel, chunk=chunk, lanes=lanes),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_out, lanes), jnp.float32),
+        interpret=_use_interpret(),
+    )(starts, firsts, ends, payload, upos.reshape(1, n))
+
+
+# ---------------------------------------------------------------- K2: apply
+
+
+def _placed_sums(u_vmem, cnt, d, tile):
+    """Window entries -> dense per-row sums [R, D] x2 via one-hot matmul."""
+    e_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
+    mask = e_iota < cnt  # [R, 1] valid-entry mask
+    # The window tail belongs to later tiles (or is uninitialized); zero it
+    # with where() — a multiply would keep NaN garbage (NaN*0 == NaN).
+    u = jnp.where(mask, u_vmem[...], 0.0)  # [R, L]
+    lrow = u[:, 2 * d:2 * d + 1]  # [R, 1] f32 tile-local row, exact < R
+    r_iota = jax.lax.broadcasted_iota(jnp.float32, (tile, tile), 1)
+    p = ((lrow == r_iota) & mask).astype(jnp.bfloat16)  # [entry, row]
+    u_hi = u.astype(jnp.bfloat16)
+    u_lo = (u - u_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    dn = (((0,), (0,)), ((), ()))  # contract the entry dim of both
+    dense = (
+        jax.lax.dot_general(p, u_hi, dn, preferred_element_type=jnp.float32)
+        + jax.lax.dot_general(p, u_lo, dn, preferred_element_type=jnp.float32)
+    )  # [row, L]
+    return dense[:, :d], dense[:, d:2 * d]  # sum(g), sum(g^2) per row
+
+
+def _k2_adagrad_kernel(tile_start_ref, table_ref, acc_ref, u_hbm_ref,
+                       table_out_ref, acc_out_ref, u_vmem, sem,
+                       *, tile, d, lr, eps):
+    t = pl.program_id(0)
+    start = tile_start_ref[t]
+    cnt = tile_start_ref[t + 1] - start
+    cp = pltpu.make_async_copy(u_hbm_ref.at[pl.ds(start, tile)], u_vmem, sem)
+    cp.start()
+    cp.wait()
+    g1, g2 = _placed_sums(u_vmem, cnt, d, tile)
+    acc_new = acc_ref[...] + g2
+    table_out_ref[...] = table_ref[...] - lr * g1 * jax.lax.rsqrt(
+        acc_new + eps
+    )
+    acc_out_ref[...] = acc_new
+
+
+def _k2_sgd_kernel(tile_start_ref, table_ref, u_hbm_ref, table_out_ref,
+                   u_vmem, sem, *, tile, d, lr):
+    t = pl.program_id(0)
+    start = tile_start_ref[t]
+    cnt = tile_start_ref[t + 1] - start
+    cp = pltpu.make_async_copy(u_hbm_ref.at[pl.ds(start, tile)], u_vmem, sem)
+    cp.start()
+    cp.wait()
+    g1, _ = _placed_sums(u_vmem, cnt, d, tile)
+    table_out_ref[...] = table_ref[...] - lr * g1
+
+
+def _k2_ftrl_kernel(tile_start_ref, table_ref, z_ref, n_ref, u_hbm_ref,
+                    table_out_ref, z_out_ref, n_out_ref, u_vmem, sem,
+                    *, tile, d, lr, l1, l2, beta):
+    t = pl.program_id(0)
+    start = tile_start_ref[t]
+    cnt = tile_start_ref[t + 1] - start
+    cp = pltpu.make_async_copy(u_hbm_ref.at[pl.ds(start, tile)], u_vmem, sem)
+    cp.start()
+    cp.wait()
+    g1, g2 = _placed_sums(u_vmem, cnt, d, tile)
+    n_old = n_ref[...]
+    w_old = table_ref[...]
+    n_new = n_old + g2
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n_old)) / lr
+    z_new = z_ref[...] + g1 - sigma * w_old
+    # FTRL-proximal closed form.  Recomputing w for untouched rows is
+    # idempotent: their (z, n) are unchanged and w is always solve(z, n)
+    # (train.sparse initializes z so this holds from step 0).
+    denom = (beta + jnp.sqrt(n_new)) / lr + l2
+    w_new = jnp.where(
+        jnp.abs(z_new) <= l1,
+        jnp.zeros_like(z_new),
+        -(z_new - jnp.sign(z_new) * l1) / denom,
+    )
+    table_out_ref[...] = w_new
+    z_out_ref[...] = z_new
+    n_out_ref[...] = n_new
+
+
+def _k2_call(kernel, tile_start, u, tables, lanes):
+    """Run a K2 variant streaming ``tables`` (tuple) tile-by-tile."""
+    v, d = tables[0].shape
+    tile = TILE
+    n_arrays = len(tables)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(v // tile,),
+        in_specs=[pl.BlockSpec((tile, d), lambda t, *_: (t, 0))] * n_arrays
+        + [pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec((tile, d), lambda t, *_: (t, 0))] * n_arrays,
+        scratch_shapes=[
+            pltpu.VMEM((tile, lanes), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((v, d), jnp.float32) for _ in range(n_arrays)
+        ],
+        input_output_aliases={1 + i: i for i in range(n_arrays)},
+        interpret=_use_interpret(),
+    )(tile_start, *tables, u)
+
+
+# ------------------------------------------------------------ orchestration
+
+
+def _prep(ids, g_rows, vocab):
+    """Sort, dedup-position, and chunk-boundary metadata (all XLA)."""
+    n = ids.shape[0]
+    d = g_rows.shape[1]
+    n_pad = -(-n // CHUNK) * CHUNK
+    if n_pad != n:
+        # Sentinel occurrences: id = vocab sorts last, lands in no real
+        # tile (tile_start covers rows < vocab), grads are zero anyway.
+        ids = jnp.concatenate(
+            [ids, jnp.full((n_pad - n,), vocab, ids.dtype)]
+        )
+        g_rows = jnp.concatenate(
+            [g_rows, jnp.zeros((n_pad - n, d), g_rows.dtype)]
+        )
+    sidx, perm = jax.lax.sort_key_val(ids, jnp.arange(n_pad, dtype=jnp.int32))
+    g_sorted = g_rows[perm]
+    prev = jnp.concatenate([jnp.full((1,), -1, sidx.dtype), sidx[:-1]])
+    flags = (sidx != prev).astype(jnp.int32)  # segment starts
+    upos = jnp.cumsum(flags) - 1  # unique-row position per occurrence
+    nxt = jnp.concatenate([sidx[1:], jnp.full((1,), -2, sidx.dtype)])
+    last = (sidx != nxt).astype(jnp.float32)  # segment ends
+    lrow = (sidx % TILE).astype(jnp.float32)  # tile-local row, exact < TILE
+    payload = jnp.concatenate(
+        [g_sorted, g_sorted * g_sorted, (lrow * last)[:, None]], axis=1
+    )  # [N, 2D+1]
+    starts = upos[::CHUNK]
+    firsts = jnp.concatenate([flags[::CHUNK], jnp.ones((1,), jnp.int32)])
+    ends = upos[CHUNK - 1::CHUNK]
+    n_unique = upos[-1] + 1
+    upos_ext = jnp.concatenate([upos, n_unique[None]])
+    ss = jnp.searchsorted(
+        sidx, jnp.arange(0, vocab + 1, TILE, dtype=sidx.dtype)
+    )
+    tile_start = upos_ext[ss].astype(jnp.int32)
+    return payload, upos, starts, firsts, ends, tile_start, n_pad
+
+
+def adagrad_apply(table, acc, ids, g_rows, *, lr, eps):
+    """Sparse Adagrad over touched rows: exact SparseApplyAdagrad semantics."""
+    vocab, d = table.shape
+    payload, upos, starts, firsts, ends, tile_start, n_pad = _prep(
+        ids, g_rows, vocab
+    )
+    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    kernel = functools.partial(
+        _k2_adagrad_kernel, tile=TILE, d=d, lr=lr, eps=eps
+    )
+    table, acc = _k2_call(kernel, tile_start, u, (table, acc), 2 * d + 1)
+    return table, acc
+
+
+def sgd_apply(table, ids, g_rows, *, lr):
+    vocab, d = table.shape
+    payload, upos, starts, firsts, ends, tile_start, n_pad = _prep(
+        ids, g_rows, vocab
+    )
+    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    kernel = functools.partial(_k2_sgd_kernel, tile=TILE, d=d, lr=lr)
+    (table,) = _k2_call(kernel, tile_start, u, (table,), 2 * d + 1)
+    return table
+
+
+def ftrl_apply(table, z, n, ids, g_rows, *, lr, l1, l2, beta):
+    vocab, d = table.shape
+    payload, upos, starts, firsts, ends, tile_start, n_pad = _prep(
+        ids, g_rows, vocab
+    )
+    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    kernel = functools.partial(
+        _k2_ftrl_kernel, tile=TILE, d=d, lr=lr, l1=l1, l2=l2, beta=beta
+    )
+    table, z, n = _k2_call(kernel, tile_start, u, (table, z, n), 2 * d + 1)
+    return table, z, n

@@ -34,9 +34,40 @@ import jax.numpy as jnp
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.libsvm import Batch
 from fast_tffm_tpu.models import fm
-from fast_tffm_tpu.ops import interaction
+from fast_tffm_tpu.ops import interaction, sparse_apply
 
 ADAGRAD_EPS = 1e-7  # matches optax.adagrad's default eps
+
+
+def use_tile_apply(cfg: FmConfig, mesh=None) -> bool:
+    """Tile-scan Pallas apply (ops.sparse_apply) vs XLA row scatter.
+
+    The tile path streams the whole table once per step, so it wants a
+    single device (the sharded variant needs shard_map; scatter handles
+    multi-device via GSPMD for now) and a TILE-aligned vocabulary.
+    """
+    if cfg.sparse_apply == "scatter":
+        return False
+    multi = mesh is not None and mesh.size > 1
+    ok = sparse_apply.supports_tile(cfg.vocabulary_size, cfg.optimizer)
+    if cfg.sparse_apply == "tile":
+        if multi:
+            raise ValueError(
+                "sparse_apply=tile is single-device for now (the sharded "
+                "variant needs shard_map); use sparse_apply=auto to let "
+                "multi-device meshes fall back to the scatter path"
+            )
+        if not ok:
+            raise ValueError(
+                "sparse_apply=tile needs vocabulary_size divisible by "
+                f"{sparse_apply.TILE} and optimizer in adagrad/ftrl/sgd"
+            )
+        return True  # explicit: run even off-TPU (interpret mode, tests)
+    if multi:
+        return False
+    # auto: only where the Mosaic kernels actually run (TPU) — interpret
+    # mode on CPU is a correctness tool, far slower than XLA scatter.
+    return ok and jax.default_backend() == "tpu"
 
 
 class SparseAdagradState(NamedTuple):
@@ -111,16 +142,22 @@ def _rows_loss_fn(
     return loss_fn
 
 
-def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows):
+def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows, tile=False):
     del w_rows  # adagrad needs no pre-update weights
     # Same formula as optax.scale_by_rss: u = g * rsqrt(acc_new + eps),
     # so sparse and dense paths agree exactly on duplicate-free batches.
     lr = cfg.learning_rate
-    acc_table = opt.acc.table.at[ids].add(g_rows * g_rows)
-    acc_rows = acc_table[ids]  # post-update accumulators for touched rows
-    table = params.table.at[ids].add(
-        -lr * g_rows * jax.lax.rsqrt(acc_rows + ADAGRAD_EPS)
-    )
+    if tile:
+        table, acc_table = sparse_apply.adagrad_apply(
+            params.table, opt.acc.table, ids, g_rows,
+            lr=lr, eps=ADAGRAD_EPS,
+        )
+    else:
+        acc_table = opt.acc.table.at[ids].add(g_rows * g_rows)
+        acc_rows = acc_table[ids]  # post-update accumulators, touched rows
+        table = params.table.at[ids].add(
+            -lr * g_rows * jax.lax.rsqrt(acc_rows + ADAGRAD_EPS)
+        )
     acc_w0 = opt.acc.w0 + dw0 * dw0
     w0 = params.w0 - lr * dw0 * jax.lax.rsqrt(acc_w0 + ADAGRAD_EPS)
     return (
@@ -136,32 +173,38 @@ def _ftrl_solve(z, n, lr, l1, l2, beta):
     )
 
 
-def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows):
+def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows, tile=False):
     lr, l1, l2, beta = (
         cfg.learning_rate, cfg.ftrl_l1, cfg.ftrl_l2, cfg.ftrl_beta,
     )
-    # Rows: FTRL recursion on the touched rows (w_rows is the pre-update
-    # gather from sparse_step, reused — no second gather).
-    #
-    # Duplicate-id care: z must receive each occurrence's gradient ONCE but
-    # the -sigma*w correction only once PER ROW.  Scatter-adding
-    # (g - sigma*w) per occurrence would apply -sigma*w k times for a row
-    # appearing k times — a positive feedback on w that diverges (w grows,
-    # |z| grows with it, the closed form returns a larger w, ...).  So:
-    # per-occurrence scatter-add of g, then a gather-modify-set for the
-    # sigma correction.  All quantities in the set are identical across
-    # duplicates (n_old/n_new/w pre-update are per-row), so the duplicate
-    # writes are well-defined.
-    n_old_rows = opt.n.table[ids]
-    n_table = opt.n.table.at[ids].add(g_rows * g_rows)
-    n_new_rows = n_table[ids]  # for dups: includes all occurrences' g^2
-    sigma = (jnp.sqrt(n_new_rows) - jnp.sqrt(n_old_rows)) / lr  # total sigma
-    zg_table = opt.z.table.at[ids].add(g_rows)
-    z_rows = zg_table[ids] - sigma * w_rows
-    z_table = zg_table.at[ids].set(z_rows)
-    new_w_rows = _ftrl_solve(z_rows, n_new_rows, lr, l1, l2, beta)
-    table = params.table.at[ids].set(new_w_rows)
-    # w0 (dense scalar path).
+    if tile:
+        table, z_table, n_table = sparse_apply.ftrl_apply(
+            params.table, opt.z.table, opt.n.table, ids, g_rows,
+            lr=lr, l1=l1, l2=l2, beta=beta,
+        )
+    else:
+        # Rows: FTRL recursion on the touched rows (w_rows is the
+        # pre-update gather from sparse_step, reused — no second gather).
+        #
+        # Duplicate-id care: z must receive each occurrence's gradient ONCE
+        # but the -sigma*w correction only once PER ROW.  Scatter-adding
+        # (g - sigma*w) per occurrence would apply -sigma*w k times for a
+        # row appearing k times — a positive feedback on w that diverges (w
+        # grows, |z| grows with it, the closed form returns a larger w,
+        # ...).  So: per-occurrence scatter-add of g, then a
+        # gather-modify-set for the sigma correction.  All quantities in
+        # the set are identical across duplicates (n_old/n_new/w pre-update
+        # are per-row), so the duplicate writes are well-defined.
+        n_old_rows = opt.n.table[ids]
+        n_table = opt.n.table.at[ids].add(g_rows * g_rows)
+        n_new_rows = n_table[ids]  # for dups: includes all occurrences' g^2
+        sigma = (jnp.sqrt(n_new_rows) - jnp.sqrt(n_old_rows)) / lr
+        zg_table = opt.z.table.at[ids].add(g_rows)
+        z_rows = zg_table[ids] - sigma * w_rows
+        z_table = zg_table.at[ids].set(z_rows)
+        new_w_rows = _ftrl_solve(z_rows, n_new_rows, lr, l1, l2, beta)
+        table = params.table.at[ids].set(new_w_rows)
+    # w0 (dense scalar path, shared by both table branches).
     n0_new = opt.n.w0 + dw0 * dw0
     sigma0 = (jnp.sqrt(n0_new) - jnp.sqrt(opt.n.w0)) / lr
     z0 = opt.z.w0 + dw0 - sigma0 * params.w0
@@ -175,10 +218,13 @@ def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows):
     )
 
 
-def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows):
+def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows, tile=False):
     del w_rows
     lr = cfg.learning_rate
-    table = params.table.at[ids].add(-lr * g_rows)
+    if tile:
+        table = sparse_apply.sgd_apply(params.table, ids, g_rows, lr=lr)
+    else:
+        table = params.table.at[ids].add(-lr * g_rows)
     return fm.FmParams(w0=params.w0 - lr * dw0, table=table), opt
 
 
@@ -199,6 +245,7 @@ def sparse_step(
     ids = batch.ids.reshape(b * f)
     g_rows = drows.reshape(b * f, d)
     params, opt_state = _APPLY[cfg.optimizer](
-        cfg, params, opt_state, ids, g_rows, dw0, rows.reshape(b * f, d)
+        cfg, params, opt_state, ids, g_rows, dw0, rows.reshape(b * f, d),
+        tile=use_tile_apply(cfg, mesh),
     )
     return params, opt_state, scores

@@ -81,5 +81,9 @@ def test_interaction_matches_model_scores(problem):
 
 def test_block_b_divides():
     for b in (8, 64, 100, 256, 1000, 16384):
-        tb = fm_pallas._block_b(b)
-        assert b % tb == 0
+        for f, d, n_bufs in ((39, 9, 1), (39, 9, 2), (64, 17, 2)):
+            tb = fm_pallas._block_b(b, f, d, n_bufs)
+            assert b % tb == 0
+            # double-buffered padded blocks stay under the VMEM budget
+            per = (n_bufs + 1) * fm_pallas._padded_bytes((tb, f, d))
+            assert 2 * per <= 6 * 1024 * 1024 or tb <= 8
