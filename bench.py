@@ -10,7 +10,13 @@ ratio vs that target, scaled by the number of chips actually used.
 
 Workload: 2nd-order FM, batch 16384, 39 features/example (Criteo layout),
 factor_num 8, vocab 2^22 hash buckets — full train step (forward, backward,
-Adagrad update, metrics) with device-resident batches, steady-state timed.
+sparse Adagrad update, metrics) with device-resident batches, steady-state
+timed.
+
+Timing note: completion is forced by reading back scalars that depend on
+both the metrics chain and the updated table.  ``block_until_ready`` alone
+under-reports on remote-tunnel platforms (it can return before the queued
+executions drain), which would inflate throughput ~1000x.
 """
 
 from __future__ import annotations
@@ -24,12 +30,19 @@ import numpy as np
 PER_CHIP_TARGET = 2_000_000 / 16  # BASELINE.md: 2M ex/s on v5e-16
 
 
+def _drain(state) -> float:
+    """Force the full dependency chain: metrics + updated params."""
+    s = float(state.metrics.loss_sum)
+    s += float(state.params.table[0, 0])
+    s += float(state.step)
+    return s
+
+
 def main() -> int:
     import jax
 
     from fast_tffm_tpu.config import FmConfig
     from fast_tffm_tpu.data.libsvm import Batch
-    from fast_tffm_tpu.parallel import mesh as mesh_lib
     from fast_tffm_tpu.train.loop import Trainer
 
     devices = jax.devices()
@@ -65,16 +78,16 @@ def main() -> int:
         )
         batches.append(trainer._put(b))
 
-    # Warmup: compile + a few steps.
+    # Warmup: compile + a few steps, fully drained.
     for i in range(3):
         trainer.state = trainer._train_step(trainer.state, batches[i % n_batches])
-    jax.block_until_ready(trainer.state)
+    _drain(trainer.state)
 
-    steps = 30
+    steps = 50
     t0 = time.perf_counter()
     for i in range(steps):
         trainer.state = trainer._train_step(trainer.state, batches[i % n_batches])
-    jax.block_until_ready(trainer.state)
+    _drain(trainer.state)
     dt = time.perf_counter() - t0
 
     ex_per_sec = steps * cfg.batch_size / dt

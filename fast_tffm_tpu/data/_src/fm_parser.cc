@@ -92,7 +92,8 @@ inline bool ParseInt(const char* s, const char* e, int64_t* out) {
   }
   uint64_t limit = neg ? (1ull << 63) : (1ull << 63) - 1;
   if (v > limit) return false;
-  *out = neg ? -static_cast<int64_t>(v) : static_cast<int64_t>(v);
+  // Negate in unsigned space: -INT64_MIN via signed unary minus is UB.
+  *out = neg ? static_cast<int64_t>(0ull - v) : static_cast<int64_t>(v);
   return true;
 }
 

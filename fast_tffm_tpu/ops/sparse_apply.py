@@ -48,6 +48,19 @@ CHUNK = 512  # C: sorted occurrences per K1 grid step
 TILE = 256  # R: table rows per K2 grid step (also the K2 window size)
 
 
+def ftrl_solve(z, n, lr, l1, l2, beta):
+    """FTRL-proximal closed form — the ONE copy all paths share.
+
+    Used by the scatter path (train.sparse), the K2 tile kernel, and the
+    sharded elementwise update; tile/scatter parity tests assume these stay
+    bit-identical.
+    """
+    denom = (beta + jnp.sqrt(n)) / lr + l2
+    return jnp.where(
+        jnp.abs(z) <= l1, jnp.zeros_like(z), -(z - jnp.sign(z) * l1) / denom
+    )
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -194,16 +207,10 @@ def _k2_ftrl_kernel(tile_start_ref, table_ref, z_ref, n_ref, u_hbm_ref,
     n_new = n_old + g2
     sigma = (jnp.sqrt(n_new) - jnp.sqrt(n_old)) / lr
     z_new = z_ref[...] + g1 - sigma * w_old
-    # FTRL-proximal closed form.  Recomputing w for untouched rows is
-    # idempotent: their (z, n) are unchanged and w is always solve(z, n)
-    # (train.sparse initializes z so this holds from step 0).
-    denom = (beta + jnp.sqrt(n_new)) / lr + l2
-    w_new = jnp.where(
-        jnp.abs(z_new) <= l1,
-        jnp.zeros_like(z_new),
-        -(z_new - jnp.sign(z_new) * l1) / denom,
-    )
-    table_out_ref[...] = w_new
+    # Recomputing w for untouched rows is idempotent: their (z, n) are
+    # unchanged and w is always ftrl_solve(z, n) (train.sparse initializes
+    # z so this holds from step 0).
+    table_out_ref[...] = ftrl_solve(z_new, n_new, lr, l1, l2, beta)
     z_out_ref[...] = z_new
     n_out_ref[...] = n_new
 
@@ -235,7 +242,72 @@ def _k2_call(kernel, tile_start, u, tables, lanes):
     )(tile_start, *tables, u)
 
 
+# ------------------------------------------------- K-place: dense expansion
+
+
+def _kplace_kernel(tile_start_ref, u_hbm_ref, out_ref, u_vmem, sem,
+                   *, tile, d):
+    """Expand the unique-entry stream into a dense [R, 2D] delta block."""
+    t = pl.program_id(0)
+    start = tile_start_ref[t]
+    cnt = tile_start_ref[t + 1] - start
+    cp = pltpu.make_async_copy(u_hbm_ref.at[pl.ds(start, tile)], u_vmem, sem)
+    cp.start()
+    cp.wait()
+    g1, g2 = _placed_sums(u_vmem, cnt, d, tile)
+    out_ref[...] = jnp.concatenate([g1, g2], axis=-1)
+
+
+def _kplace_call(tile_start, u, vocab_local, d, lanes):
+    tile = TILE
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(vocab_local // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((tile, 2 * d), lambda t, *_: (t, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tile, lanes), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_kplace_kernel, tile=tile, d=d),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((vocab_local, 2 * d), jnp.float32),
+        interpret=_use_interpret(),
+    )(tile_start, u)
+
+
+def dense_delta(ids, g_rows, *, vocab, vocab_local, row_lo):
+    """Per-shard dense (sum g, sum g^2) delta [vocab_local, 2D].
+
+    ``row_lo`` (traced OK) is the first global row of the local table
+    shard; only occurrences landing in [row_lo, row_lo + vocab_local)
+    contribute.  This is the sharded-tile building block: shard_map runs it
+    per device on the device's data shard, psums the result over the data
+    axis, and applies the optimizer formula elementwise.
+    """
+    d = g_rows.shape[1]
+    payload, upos, starts, firsts, ends, sidx, n_pad = _prep(
+        ids, g_rows, vocab
+    )
+    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    tile_start = _tile_starts(
+        sidx, upos, row_lo + jnp.arange(0, vocab_local + 1, TILE,
+                                        dtype=sidx.dtype)
+    )
+    return _kplace_call(tile_start, u, vocab_local, d, 2 * d + 1)
+
+
 # ------------------------------------------------------------ orchestration
+
+
+def _tile_starts(sidx, upos, boundaries):
+    """Unique-entry index of the first id >= each row boundary."""
+    n_unique = upos[-1] + 1
+    upos_ext = jnp.concatenate([upos, n_unique[None]])
+    ss = jnp.searchsorted(sidx, boundaries)
+    return upos_ext[ss].astype(jnp.int32)
 
 
 def _prep(ids, g_rows, vocab):
@@ -266,22 +338,24 @@ def _prep(ids, g_rows, vocab):
     starts = upos[::CHUNK]
     firsts = jnp.concatenate([flags[::CHUNK], jnp.ones((1,), jnp.int32)])
     ends = upos[CHUNK - 1::CHUNK]
-    n_unique = upos[-1] + 1
-    upos_ext = jnp.concatenate([upos, n_unique[None]])
-    ss = jnp.searchsorted(
-        sidx, jnp.arange(0, vocab + 1, TILE, dtype=sidx.dtype)
+    return payload, upos, starts, firsts, ends, sidx, n_pad
+
+
+def _dedup_and_starts(ids, g_rows, vocab):
+    payload, upos, starts, firsts, ends, sidx, n_pad = _prep(
+        ids, g_rows, vocab
     )
-    tile_start = upos_ext[ss].astype(jnp.int32)
-    return payload, upos, starts, firsts, ends, tile_start, n_pad
+    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    tile_start = _tile_starts(
+        sidx, upos, jnp.arange(0, vocab + 1, TILE, dtype=sidx.dtype)
+    )
+    return u, tile_start
 
 
 def adagrad_apply(table, acc, ids, g_rows, *, lr, eps):
     """Sparse Adagrad over touched rows: exact SparseApplyAdagrad semantics."""
     vocab, d = table.shape
-    payload, upos, starts, firsts, ends, tile_start, n_pad = _prep(
-        ids, g_rows, vocab
-    )
-    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
     kernel = functools.partial(
         _k2_adagrad_kernel, tile=TILE, d=d, lr=lr, eps=eps
     )
@@ -291,10 +365,7 @@ def adagrad_apply(table, acc, ids, g_rows, *, lr, eps):
 
 def sgd_apply(table, ids, g_rows, *, lr):
     vocab, d = table.shape
-    payload, upos, starts, firsts, ends, tile_start, n_pad = _prep(
-        ids, g_rows, vocab
-    )
-    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
     kernel = functools.partial(_k2_sgd_kernel, tile=TILE, d=d, lr=lr)
     (table,) = _k2_call(kernel, tile_start, u, (table,), 2 * d + 1)
     return table
@@ -302,12 +373,99 @@ def sgd_apply(table, ids, g_rows, *, lr):
 
 def ftrl_apply(table, z, n, ids, g_rows, *, lr, l1, l2, beta):
     vocab, d = table.shape
-    payload, upos, starts, firsts, ends, tile_start, n_pad = _prep(
-        ids, g_rows, vocab
-    )
-    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
     kernel = functools.partial(
         _k2_ftrl_kernel, tile=TILE, d=d, lr=lr, l1=l1, l2=l2, beta=beta
     )
     table, z, n = _k2_call(kernel, tile_start, u, (table, z, n), 2 * d + 1)
     return table, z, n
+
+
+# ------------------------------------------------------- sharded (shard_map)
+
+
+def supports_tile_sharded(vocab: int, optimizer: str, model_shards: int) -> bool:
+    return (
+        optimizer in ("adagrad", "ftrl", "sgd")
+        and vocab % (model_shards * TILE) == 0
+        and vocab // model_shards >= TILE
+    )
+
+
+def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
+                  g_rows, vocab):
+    """shard_map wrapper: per-device K1 + dense placement, psum over data,
+    elementwise optimizer update on the local table shard.
+
+    This is the GSPMD-era replacement for the reference's PS scatter push
+    (SURVEY.md §3.2): the routing of sparse updates to owning shards
+    becomes a dense per-shard delta allreduced over the data axis — the
+    same collective pattern as sync data-parallel gradient exchange, so it
+    rides ICI.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    model_shards = mesh.shape[model_axis]
+    vocab_local = vocab // model_shards
+
+    def local(ids_l, g_l, *tables_l):
+        m = jax.lax.axis_index(model_axis)
+        dense = dense_delta(
+            ids_l, g_l, vocab=vocab,
+            vocab_local=vocab_local, row_lo=m * vocab_local,
+        )
+        dense = jax.lax.psum(dense, data_axis)
+        d = g_l.shape[1]
+        return update_fn(dense[:, :d], dense[:, d:], *tables_l)
+
+    n_tables = len(tables)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(data_axis), P(data_axis, None))
+        + (P(model_axis, None),) * n_tables,
+        out_specs=(P(model_axis, None),) * n_tables
+        if n_tables > 1 else P(model_axis, None),
+        check_vma=False,  # pallas_call outputs carry no vma annotations
+    )(ids, g_rows, *tables)
+
+
+def adagrad_apply_sharded(table, acc, ids, g_rows, *, lr, eps, mesh,
+                          data_axis, model_axis):
+    def update(g1, g2, table_l, acc_l):
+        acc_new = acc_l + g2
+        return (
+            table_l - lr * g1 * jax.lax.rsqrt(acc_new + eps),
+            acc_new,
+        )
+
+    return _sharded_call(
+        update, mesh, data_axis, model_axis, (table, acc), ids, g_rows,
+        table.shape[0],
+    )
+
+
+def sgd_apply_sharded(table, ids, g_rows, *, lr, mesh, data_axis,
+                      model_axis):
+    def update(g1, g2, table_l):
+        del g2
+        return table_l - lr * g1
+
+    return _sharded_call(
+        update, mesh, data_axis, model_axis, (table,), ids, g_rows,
+        table.shape[0],
+    )
+
+
+def ftrl_apply_sharded(table, z, n, ids, g_rows, *, lr, l1, l2, beta, mesh,
+                       data_axis, model_axis):
+    def update(g1, g2, table_l, z_l, n_l):
+        n_new = n_l + g2
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n_l)) / lr
+        z_new = z_l + g1 - sigma * table_l
+        return ftrl_solve(z_new, n_new, lr, l1, l2, beta), z_new, n_new
+
+    return _sharded_call(
+        update, mesh, data_axis, model_axis, (table, z, n), ids, g_rows,
+        table.shape[0],
+    )

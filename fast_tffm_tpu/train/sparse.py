@@ -35,39 +35,44 @@ from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.libsvm import Batch
 from fast_tffm_tpu.models import fm
 from fast_tffm_tpu.ops import interaction, sparse_apply
+from fast_tffm_tpu.parallel import mesh as mesh_lib
 
 ADAGRAD_EPS = 1e-7  # matches optax.adagrad's default eps
 
 
-def use_tile_apply(cfg: FmConfig, mesh=None) -> bool:
-    """Tile-scan Pallas apply (ops.sparse_apply) vs XLA row scatter.
+def apply_mode(cfg: FmConfig, mesh=None) -> str:
+    """How sparse updates hit the table: 'scatter' | 'tile' | 'sharded'.
 
-    The tile path streams the whole table once per step, so it wants a
-    single device (the sharded variant needs shard_map; scatter handles
-    multi-device via GSPMD for now) and a TILE-aligned vocabulary.
+    'tile' (single device): fused K2 streams table+state once per step.
+    'sharded' (multi device): per-device dense deltas psum'd over the data
+    axis, applied to the local model shard under shard_map.  Both need a
+    TILE-aligned (per-shard) vocabulary and a row-local optimizer;
+    otherwise the XLA row-'scatter' path handles it via GSPMD.
     """
     if cfg.sparse_apply == "scatter":
-        return False
+        return "scatter"
     multi = mesh is not None and mesh.size > 1
-    ok = sparse_apply.supports_tile(cfg.vocabulary_size, cfg.optimizer)
+    if multi:
+        ok = sparse_apply.supports_tile_sharded(
+            cfg.vocabulary_size, cfg.optimizer,
+            mesh.shape[mesh_lib.MODEL_AXIS],
+        )
+    else:
+        ok = sparse_apply.supports_tile(cfg.vocabulary_size, cfg.optimizer)
+    tiled = "sharded" if multi else "tile"
     if cfg.sparse_apply == "tile":
-        if multi:
-            raise ValueError(
-                "sparse_apply=tile is single-device for now (the sharded "
-                "variant needs shard_map); use sparse_apply=auto to let "
-                "multi-device meshes fall back to the scatter path"
-            )
         if not ok:
             raise ValueError(
-                "sparse_apply=tile needs vocabulary_size divisible by "
-                f"{sparse_apply.TILE} and optimizer in adagrad/ftrl/sgd"
+                "sparse_apply=tile needs a vocabulary_size divisible by "
+                f"model_shards*{sparse_apply.TILE} and optimizer in "
+                "adagrad/ftrl/sgd"
             )
-        return True  # explicit: run even off-TPU (interpret mode, tests)
-    if multi:
-        return False
+        return tiled  # explicit: run even off-TPU (interpret mode, tests)
     # auto: only where the Mosaic kernels actually run (TPU) — interpret
     # mode on CPU is a correctness tool, far slower than XLA scatter.
-    return ok and jax.default_backend() == "tpu"
+    if ok and jax.default_backend() == "tpu":
+        return tiled
+    return "scatter"
 
 
 class SparseAdagradState(NamedTuple):
@@ -142,12 +147,19 @@ def _rows_loss_fn(
     return loss_fn
 
 
-def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows, tile=False):
+def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows,
+                   mode="scatter", mesh=None):
     del w_rows  # adagrad needs no pre-update weights
     # Same formula as optax.scale_by_rss: u = g * rsqrt(acc_new + eps),
     # so sparse and dense paths agree exactly on duplicate-free batches.
     lr = cfg.learning_rate
-    if tile:
+    if mode == "sharded":
+        table, acc_table = sparse_apply.adagrad_apply_sharded(
+            params.table, opt.acc.table, ids, g_rows,
+            lr=lr, eps=ADAGRAD_EPS, mesh=mesh,
+            data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
+        )
+    elif mode == "tile":
         table, acc_table = sparse_apply.adagrad_apply(
             params.table, opt.acc.table, ids, g_rows,
             lr=lr, eps=ADAGRAD_EPS,
@@ -166,18 +178,22 @@ def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows, tile=False):
     )
 
 
-def _ftrl_solve(z, n, lr, l1, l2, beta):
-    denom = (beta + jnp.sqrt(n)) / lr + l2
-    return jnp.where(
-        jnp.abs(z) <= l1, jnp.zeros_like(z), -(z - jnp.sign(z) * l1) / denom
-    )
+# One shared closed form across scatter / tile-kernel / sharded paths.
+_ftrl_solve = sparse_apply.ftrl_solve
 
 
-def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows, tile=False):
+def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows,
+                mode="scatter", mesh=None):
     lr, l1, l2, beta = (
         cfg.learning_rate, cfg.ftrl_l1, cfg.ftrl_l2, cfg.ftrl_beta,
     )
-    if tile:
+    if mode == "sharded":
+        table, z_table, n_table = sparse_apply.ftrl_apply_sharded(
+            params.table, opt.z.table, opt.n.table, ids, g_rows,
+            lr=lr, l1=l1, l2=l2, beta=beta, mesh=mesh,
+            data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
+        )
+    elif mode == "tile":
         table, z_table, n_table = sparse_apply.ftrl_apply(
             params.table, opt.z.table, opt.n.table, ids, g_rows,
             lr=lr, l1=l1, l2=l2, beta=beta,
@@ -218,10 +234,16 @@ def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows, tile=False):
     )
 
 
-def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows, tile=False):
+def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows,
+               mode="scatter", mesh=None):
     del w_rows
     lr = cfg.learning_rate
-    if tile:
+    if mode == "sharded":
+        table = sparse_apply.sgd_apply_sharded(
+            params.table, ids, g_rows, lr=lr, mesh=mesh,
+            data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
+        )
+    elif mode == "tile":
         table = sparse_apply.sgd_apply(params.table, ids, g_rows, lr=lr)
     else:
         table = params.table.at[ids].add(-lr * g_rows)
@@ -246,6 +268,6 @@ def sparse_step(
     g_rows = drows.reshape(b * f, d)
     params, opt_state = _APPLY[cfg.optimizer](
         cfg, params, opt_state, ids, g_rows, dw0, rows.reshape(b * f, d),
-        tile=use_tile_apply(cfg, mesh),
+        mode=apply_mode(cfg, mesh), mesh=mesh,
     )
     return params, opt_state, scores

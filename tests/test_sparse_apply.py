@@ -137,3 +137,88 @@ def test_supports_tile_gating():
     assert sparse_apply.supports_tile(2048, "adagrad")
     assert not sparse_apply.supports_tile(100, "adagrad")  # not TILE-aligned
     assert not sparse_apply.supports_tile(2048, "adam")
+    assert sparse_apply.supports_tile_sharded(4096, "adagrad", 2)
+    assert not sparse_apply.supports_tile_sharded(2048, "ftrl", 16)
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4)])
+def test_adagrad_sharded_matches_scatter(shape):
+    """Sharded tile apply on a (data, model) virtual mesh == scatter."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    V_s = 4096  # divisible by model_shards * TILE for model <= 16
+    devs = np.array(jax.devices()[:8]).reshape(shape)
+    mesh = Mesh(devs, ("data", "model"))
+    ids, g = _ids_grads(7, 2048, hot=500)
+    rng = np.random.default_rng(8)
+    table = jnp.asarray(rng.uniform(-0.1, 0.1, (V_s, D)).astype(np.float32))
+    acc = jnp.full((V_s, D), 0.1, jnp.float32)
+    ids = ids % V_s
+    lr, eps = 0.05, sparse_lib.ADAGRAD_EPS
+
+    table_sh = jax.device_put(table, NamedSharding(mesh, P("model", None)))
+    acc_sh = jax.device_put(acc, NamedSharding(mesh, P("model", None)))
+    ids_sh = jax.device_put(ids, NamedSharding(mesh, P("data")))
+    g_sh = jax.device_put(g, NamedSharding(mesh, P("data", None)))
+
+    t_tile, a_tile = jax.jit(
+        lambda t, a, i, g: sparse_apply.adagrad_apply_sharded(
+            t, a, i, g, lr=lr, eps=eps, mesh=mesh,
+            data_axis="data", model_axis="model",
+        )
+    )(table_sh, acc_sh, ids_sh, g_sh)
+
+    a_ref = acc.at[ids].add(g * g)
+    t_ref = table.at[ids].add(-lr * g * jax.lax.rsqrt(a_ref[ids] + eps))
+    np.testing.assert_allclose(t_tile, t_ref, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(a_tile, a_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_full_sparse_step_sharded_tile():
+    """sparse_step with tile apply on a 4x2 mesh == single-device scatter."""
+    from jax.sharding import Mesh
+
+    V_s = 2048
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    cfg = FmConfig(
+        vocabulary_size=V_s, factor_num=D - 1, max_features=8,
+        batch_size=64, optimizer="adagrad", learning_rate=0.05,
+        sparse_apply="tile", mesh_data=4, mesh_model=2,
+    )
+    rng = np.random.default_rng(9)
+    batch = Batch(
+        labels=rng.integers(0, 2, 64).astype(np.float32),
+        ids=rng.integers(0, V_s, (64, 8)).astype(np.int32),
+        vals=rng.uniform(0.1, 1.0, (64, 8)).astype(np.float32),
+        fields=np.zeros((64, 8), np.int32),
+        weights=np.ones((64,), np.float32),
+    )
+    from fast_tffm_tpu.models import fm
+    from fast_tffm_tpu.parallel import mesh as mesh_lib
+
+    params0 = fm.init_params(jax.random.PRNGKey(0), cfg)
+    results = {}
+    for mode, m in (("tile", mesh), ("scatter", None)):
+        cfg_m = FmConfig(**{**cfg.__dict__, "sparse_apply": mode,
+                            "train_files": [], "weight_files": [],
+                            "validation_files": [], "predict_files": []})
+        params = params0
+        opt = sparse_lib.init_sparse_opt_state(cfg_m, params)
+        if m is not None:
+            params = mesh_lib.shard_params(params, m)
+            b = mesh_lib.shard_batch(jax.tree.map(jnp.asarray, batch), m)
+        else:
+            b = jax.tree.map(jnp.asarray, batch)
+        step = jax.jit(
+            lambda p, o, bb, c=cfg_m, mm=m: sparse_lib.sparse_step(
+                c, p, o, bb, mesh=mm
+            )
+        )
+        for _ in range(2):
+            params, opt, _ = step(params, opt, b)
+        results[mode] = params
+    np.testing.assert_allclose(
+        results["tile"].table, results["scatter"].table,
+        rtol=1e-4, atol=1e-6,
+    )
