@@ -87,6 +87,16 @@ class FmConfig:
     predict_files: list[str] = dataclasses.field(default_factory=list)
     score_path: str = "./scores.txt"
 
+    # --- observability (SURVEY.md §5: tracing/metrics rebuild) ---
+    # Directory for a jax.profiler trace of steps
+    # [profile_start_step, profile_start_step + profile_steps). Empty = off.
+    profile_dir: str = ""
+    profile_start_step: int = 10
+    profile_steps: int = 5
+    # JSONL stream of per-interval training metrics (step, examples,
+    # loss, auc, examples_per_sec, elapsed). Empty = off.
+    metrics_file: str = ""
+
     # --- [Tpu] (new; not in reference) ---
     # Max features per example; batches are padded to this static shape.
     max_features: int = 64
@@ -185,6 +195,10 @@ _KEYMAP = {
     "seed": ("seed", int),
     "predict_files": ("predict_files", _parse_files),
     "score_path": ("score_path", str),
+    "profile_dir": ("profile_dir", str),
+    "profile_start_step": ("profile_start_step", int),
+    "profile_steps": ("profile_steps", int),
+    "metrics_file": ("metrics_file", str),
     "max_features": ("max_features", int),
     "mesh_data": ("mesh_data", int),
     "mesh_model": ("mesh_model", int),

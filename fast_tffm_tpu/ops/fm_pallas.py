@@ -7,18 +7,32 @@ kernels over the *gathered* table rows:
   forward:  rows [B,F,D], vals [B,F] -> scores [B]   (saves s1 [B,K])
   backward: rows, vals, s1, dscores  -> per-occurrence row grads [B,F,D]
 
-The gather itself (``table[ids]``) and the scatter-add of row grads stay in
-XLA — its gather/scatter paths are the fast ones on TPU — while these
-kernels fuse all the elementwise/reduction math so the [B,F,K] ``xv``
-intermediates never touch HBM.
+The gather itself (``table[ids]``) and the scatter-add of row grads stay
+outside (XLA gather / ops.sparse_apply) while these kernels fuse all the
+elementwise/reduction math so the [B,F,K] ``xv`` intermediates never touch
+HBM.
 
-Closed-form backward (SURVEY.md §3.4):
-  dV[b,f,k] = g_b * x_bf * (s1[b,k] - V[b,f,k]*x_bf)
-  dw[b,f]   = g_b * x_bf
-  dw0       = sum_b g_b            (computed by the caller)
+Layout: the naive [TB, F, D] block tiles D (e.g. 9) onto the 128-lane
+minor dimension — a 14x VMEM/VPU waste that OOMs scoped VMEM at B=16k.
+Instead rows enter *flattened* as [B, F*D] (a free bitcast of the gather
+output), whose minor dim (~F*D = 351 -> 384) tiles at ~91% utilization.
+The per-feature reductions that the 3-D layout got "for free" become tiny
+one-hot MXU matmuls with iota-built selection matrices:
 
-Both kernels are pure VPU work (no MXU): the op is bandwidth-bound, so the
-win is fusion, not FLOPs.
+  xe  = x @ R        R[f, f*D+j] = 1      broadcast x_f across its row slot
+  y   = rows * xe                         y[b, f*D+j] = row-elem * x_f
+  S   = y @ M        M[c, c mod D] = 1    S[:,0] = linear, S[:,1+k] = s1_k
+  S2  = (y*y) @ M                         S2[:,1+k] = s2_k
+  score = S[:,0] + 0.5 * sum_k (S[:,1+k]^2 - S2[:,1+k])
+
+Backward (closed-form FmGrad, SURVEY.md §3.4), same layout:
+
+  s1e = [1|s1] @ Mt  Mt[j, f*D+j] = 1     broadcast s1_k across features
+  drows = (g * xe) * (s1e - y * maskv)    maskv kills the j=0 (w) column
+  (j=0: g*x_f;  j=1+k: g*x_f*(s1_k - v*x_f))
+
+One-hot matmuls run as two-pass bf16 hi/lo splits (~f32 precision, exact
+0/1 lhs).  All selection matrices are built in-kernel from iota compares.
 """
 
 from __future__ import annotations
@@ -28,99 +42,124 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 
-def _padded_bytes(shape: tuple[int, ...], itemsize: int = 4) -> int:
-    """VMEM footprint of one block: last two dims tile-pad to (8, 128)."""
-    if len(shape) < 2:
-        return itemsize * max(shape[0], 1) * 128
-    dims = list(shape)
-    dims[-2] = -(-dims[-2] // 8) * 8
-    dims[-1] = -(-dims[-1] // 128) * 128
-    n = 1
-    for d in dims:
-        n *= d
-    return n * itemsize
-
-
-def _block_b(batch: int, f: int, d: int, n_bufs: int) -> int:
-    """Batch-tile size: keep double-buffered padded blocks under the
-    ~16MB scoped-VMEM limit (with headroom), sublane-aligned.
-
-    ``n_bufs`` counts the [TB, F, D]-shaped blocks in flight (the [TB, F]
-    and [TB, K] blocks are small by comparison but included via the +1).
-    """
-    budget = 6 * 1024 * 1024  # conservative vs the 16MB scoped-VMEM limit
-
-    def fits(tb: int) -> bool:
-        per_block = (n_bufs + 1) * _padded_bytes((tb, f, d))
-        return 2 * per_block <= budget  # x2 for double buffering
-
+def _block_b(batch: int, bytes_per_row: int) -> int:
+    """Largest sublane-aligned divisor of ``batch`` whose double-buffered
+    blocks stay well under the ~16MB scoped-VMEM limit."""
+    budget = 6 * 1024 * 1024
     divisors = sorted(
-        (tb for tb in range(1, min(batch, 1024) + 1) if batch % tb == 0),
+        (tb for tb in range(1, min(batch, 2048) + 1) if batch % tb == 0),
         reverse=True,
     )
-    for tb in divisors:  # largest sublane-aligned divisor within budget
-        if tb % 8 == 0 and fits(tb):
+    for tb in divisors:
+        if tb % 8 == 0 and 2 * 3 * tb * bytes_per_row <= budget:
             return tb
-    for tb in divisors:  # any divisor within budget
-        if fits(tb):
+    for tb in divisors:
+        if 2 * 3 * tb * bytes_per_row <= budget:
             return tb
     return divisors[-1]
 
 
-def _fwd_kernel(rows_ref, vals_ref, score_ref, s1_ref):
-    rows = rows_ref[:]  # [TB, F, D]
-    vals = vals_ref[:]  # [TB, F]
-    w = rows[:, :, 0]
-    v = rows[:, :, 1:]
-    xv = v * vals[:, :, None]  # [TB, F, K]
-    s1 = jnp.sum(xv, axis=1)  # [TB, K]
-    s2 = jnp.sum(xv * xv, axis=1)
-    linear = jnp.sum(w * vals, axis=1)  # [TB]
-    inter = 0.5 * jnp.sum(s1 * s1 - s2, axis=-1)
-    score_ref[:] = (linear + inter)[:, None]  # [TB, 1]
-    s1_ref[:] = s1
+def _pad128(n: int) -> int:
+    return -(-n // 128) * 128
 
 
-def _bwd_kernel(rows_ref, vals_ref, s1_ref, g_ref, drows_ref):
-    rows = rows_ref[:]  # [TB, F, D]
-    vals = vals_ref[:]  # [TB, F]
-    s1 = s1_ref[:]  # [TB, K]
-    g = g_ref[:]  # [TB, 1]
-    v = rows[:, :, 1:]
-    gx = g * vals  # [TB, F]
-    dv = gx[:, :, None] * (s1[:, None, :] - v * vals[:, :, None])  # [TB,F,K]
-    dw = gx[:, :, None]  # [TB, F, 1]
-    drows_ref[:] = jnp.concatenate([dw, dv], axis=-1)
+def _r_matrix(f: int, d: int):
+    """R[f, f*D+j] = 1: broadcasts per-feature x into its D row slots."""
+    fd = f * d
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (f, fd), 1)
+    f_iota = jax.lax.broadcasted_iota(jnp.int32, (f, fd), 0)
+    return (c_iota // d == f_iota).astype(jnp.bfloat16)  # [F, FD]
+
+
+def _m_matrix(f: int, d: int):
+    """M[c, c mod D] = 1: sums row slot j across features."""
+    fd = f * d
+    cm_iota = jax.lax.broadcasted_iota(jnp.int32, (fd, d), 0)
+    j_iota = jax.lax.broadcasted_iota(jnp.int32, (fd, d), 1)
+    return (cm_iota % d == j_iota).astype(jnp.bfloat16)  # [FD, D]
+
+
+def _dot_f32_rhs(a_f32, b_bf16):
+    """f32-lhs x bf16-0/1-rhs matmul at f32 precision.
+
+    Three-term bf16 split (hi + mid + lo covers ~24 mantissa bits): the
+    score's s1^2 - s2 cancellation amplifies relative error, so the
+    two-term split's ~2^-17 is not enough here.  Three small bf16 matmuls
+    are still negligible next to the kernel's HBM traffic.
+    """
+    a_hi = a_f32.astype(jnp.bfloat16)
+    r1 = a_f32 - a_hi.astype(jnp.float32)
+    a_mid = r1.astype(jnp.bfloat16)
+    a_lo = (r1 - a_mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    return (
+        jax.lax.dot(a_hi, b_bf16, preferred_element_type=jnp.float32)
+        + jax.lax.dot(a_mid, b_bf16, preferred_element_type=jnp.float32)
+        + jax.lax.dot(a_lo, b_bf16, preferred_element_type=jnp.float32)
+    )
+
+
+def _fwd_kernel(rows_ref, vals_ref, score_ref, s1_ref, *, f, d):
+    rows = rows_ref[...]  # [TB, FD] f32
+    vals = vals_ref[...]  # [TB, F] f32
+    r_mat, m_mat = _r_matrix(f, d), _m_matrix(f, d)
+    xe = _dot_f32_rhs(vals, r_mat)  # [TB, FD]; one term per column
+    y = rows * xe
+    s = _dot_f32_rhs(y, m_mat)  # [TB, D]: linear | s1
+    s2 = _dot_f32_rhs(y * y, m_mat)  # [TB, D]: _ | s2
+    s1 = s[:, 1:]
+    inter = 0.5 * jnp.sum(s1 * s1 - s2[:, 1:], axis=-1, keepdims=True)
+    score_ref[...] = s[:, 0:1] + inter  # [TB, 1]
+    s1_ref[...] = s1
+
+
+def _bwd_kernel(rows_ref, vals_ref, s1_ref, g_ref, drows_ref, *, f, d):
+    rows = rows_ref[...]  # [TB, FD]
+    vals = vals_ref[...]  # [TB, F]
+    s1 = s1_ref[...]  # [TB, K]
+    g = g_ref[...]  # [TB, 1]
+    fd = f * d
+    xe = _dot_f32_rhs(vals, _r_matrix(f, d))
+    y = rows * xe
+    ones = jnp.ones((s1.shape[0], 1), jnp.float32)
+    u = jnp.concatenate([ones, s1], axis=1)  # [TB, D]
+    # Mt[j, f*D+j] = 1, built directly (no in-kernel transpose of m_mat).
+    j_iota = jax.lax.broadcasted_iota(jnp.int32, (d, fd), 0)
+    cj_iota = jax.lax.broadcasted_iota(jnp.int32, (d, fd), 1)
+    mt_mat = (cj_iota % d == j_iota).astype(jnp.bfloat16)  # [D, FD]
+    s1e = _dot_f32_rhs(u, mt_mat)  # [TB, FD]; one term per column
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (1, fd), 1)
+    maskv = (c_iota % d != 0).astype(jnp.float32)  # kill w column in y
+    drows_ref[...] = (g * xe) * (s1e - y * maskv)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fm_scores_pallas(rows: jax.Array, vals: jax.Array, interpret: bool = False):
-    """Forward: (scores [B], s1 [B, K]) from gathered rows."""
+    """Forward: (scores [B], s1 [B, K]) from gathered rows [B, F, D]."""
     b, f, d = rows.shape
-    tb = _block_b(b, f, d, n_bufs=1)
+    fd = f * d
+    rows2 = rows.reshape(b, fd)  # free bitcast: same dense layout
+    bytes_per_row = 4 * (2 * _pad128(fd) + _pad128(f))
+    tb = _block_b(b, bytes_per_row)
     grid = (b // tb,)
     scores, s1 = pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel, f=f, d=d),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tb, f, d), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, fd), lambda i: (i, 0)),
+            pl.BlockSpec((tb, f), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((tb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, d - 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tb, d - 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, 1), rows.dtype),
             jax.ShapeDtypeStruct((b, d - 1), rows.dtype),
         ],
         interpret=interpret,
-    )(rows, vals)
+    )(rows2, vals)
     return scores[:, 0], s1
 
 
@@ -134,21 +173,22 @@ def fm_grad_pallas(
 ):
     """Backward: per-occurrence row grads [B, F, D]."""
     b, f, d = rows.shape
-    tb = _block_b(b, f, d, n_bufs=2)
+    fd = f * d
+    rows2 = rows.reshape(b, fd)
+    bytes_per_row = 4 * (3 * _pad128(fd) + _pad128(f))
+    tb = _block_b(b, bytes_per_row)
     grid = (b // tb,)
-    return pl.pallas_call(
-        _bwd_kernel,
+    drows = pl.pallas_call(
+        functools.partial(_bwd_kernel, f=f, d=d),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tb, f, d), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, d - 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, fd), lambda i: (i, 0)),
+            pl.BlockSpec((tb, f), lambda i: (i, 0)),
+            pl.BlockSpec((tb, d - 1), lambda i: (i, 0)),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((tb, f, d), lambda i: (i, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, f, d), rows.dtype),
+        out_specs=pl.BlockSpec((tb, fd), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, fd), rows.dtype),
         interpret=interpret,
-    )(rows, vals, s1, dscores[:, None])
+    )(rows2, vals, s1, dscores[:, None])
+    return drows.reshape(b, f, d)

@@ -13,6 +13,7 @@ async-dispatched.
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 from functools import partial
@@ -276,25 +277,56 @@ class Trainer:
             epochs=cfg.epoch_num,
             shuffle=True,
         )
+        metrics_out = (
+            open(cfg.metrics_file, "a") if cfg.metrics_file else None
+        )
+        profiling = False
         t0 = time.time()
         last_log_t, last_log_ex = t0, 0.0
         seen = 0.0
         stepno = 0
-        for batch in pipeline:
-            self.state = self._train_step(self.state, self._put(batch))
-            stepno += 1
-            seen += float(np.sum(batch.weights > 0))
-            if cfg.log_steps and stepno % cfg.log_steps == 0:
-                m = _finalize_metrics(self.state.metrics, cfg.loss_type)
-                now = time.time()
-                rate = (seen - last_log_ex) / max(now - last_log_t, 1e-9)
-                last_log_t, last_log_ex = now, seen
-                log.info(
-                    "step %d examples %d loss %.6f auc %.4f ex/s %.0f",
-                    stepno, int(seen), m["loss"], m["auc"], rate,
-                )
-            if cfg.save_steps and stepno % cfg.save_steps == 0:
-                self.save(stepno)
+        try:
+            for batch in pipeline:
+                if cfg.profile_dir and stepno == cfg.profile_start_step:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                self.state = self._train_step(self.state, self._put(batch))
+                stepno += 1
+                if profiling and stepno >= (
+                    cfg.profile_start_step + cfg.profile_steps
+                ):
+                    jax.block_until_ready(self.state)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    log.info("profiler trace written to %s", cfg.profile_dir)
+                seen += float(np.sum(batch.weights > 0))
+                if cfg.log_steps and stepno % cfg.log_steps == 0:
+                    m = _finalize_metrics(self.state.metrics, cfg.loss_type)
+                    now = time.time()
+                    rate = (seen - last_log_ex) / max(now - last_log_t, 1e-9)
+                    last_log_t, last_log_ex = now, seen
+                    log.info(
+                        "step %d examples %d loss %.6f auc %.4f ex/s %.0f",
+                        stepno, int(seen), m["loss"], m["auc"], rate,
+                    )
+                    if metrics_out is not None:
+                        metrics_out.write(json.dumps({
+                            "step": stepno,
+                            "examples": seen,
+                            "loss": m["loss"],
+                            "auc": m["auc"],
+                            "examples_per_sec": rate,
+                            "elapsed": now - t0,
+                        }) + "\n")
+                        metrics_out.flush()
+                if cfg.save_steps and stepno % cfg.save_steps == 0:
+                    self.save(stepno)
+        finally:
+            # An abandoned trace poisons any later start_trace in-process.
+            if profiling:
+                jax.profiler.stop_trace()
+            if metrics_out is not None:
+                metrics_out.close()
         train_metrics = _finalize_metrics(self.state.metrics, cfg.loss_type)
         train_metrics["examples_per_sec"] = seen / max(time.time() - t0, 1e-9)
         train_metrics["steps"] = stepno

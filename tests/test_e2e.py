@@ -142,3 +142,32 @@ max_features = 8
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert os.path.exists(tmp_path / "scores_cli.txt")
+
+
+def test_metrics_file_and_profiler(tmp_path, rng):
+    """Observability: metrics JSONL stream + jax.profiler trace dir."""
+    import json
+
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.train.loop import Trainer
+
+    data = tmp_path / "train.libsvm"
+    with open(data, "w") as f:
+        for i in range(256):
+            f.write(f"{i % 2} {rng.integers(0, 64)}:1 {rng.integers(0, 64)}:0.5\n")
+    cfg = FmConfig(
+        vocabulary_size=64, factor_num=4, max_features=4, batch_size=32,
+        train_files=[str(data)], epoch_num=2, log_steps=4,
+        model_file=str(tmp_path / "model"),
+        metrics_file=str(tmp_path / "metrics.jsonl"),
+        profile_dir=str(tmp_path / "trace"),
+        profile_start_step=2, profile_steps=2,
+    )
+    Trainer(cfg).train()
+    lines = open(tmp_path / "metrics.jsonl").read().splitlines()
+    assert lines, "metrics stream empty"
+    rec = json.loads(lines[-1])
+    assert {"step", "examples", "loss", "auc", "examples_per_sec",
+            "elapsed"} <= set(rec)
+    assert rec["examples"] == 512
+    assert any(os.scandir(tmp_path / "trace")), "no profiler trace written"

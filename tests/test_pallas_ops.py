@@ -81,9 +81,10 @@ def test_interaction_matches_model_scores(problem):
 
 def test_block_b_divides():
     for b in (8, 64, 100, 256, 1000, 16384):
-        for f, d, n_bufs in ((39, 9, 1), (39, 9, 2), (64, 17, 2)):
-            tb = fm_pallas._block_b(b, f, d, n_bufs)
+        for f, d in ((39, 9), (64, 17)):
+            bytes_per_row = 4 * (2 * fm_pallas._pad128(f * d)
+                                 + fm_pallas._pad128(f))
+            tb = fm_pallas._block_b(b, bytes_per_row)
             assert b % tb == 0
-            # double-buffered padded blocks stay under the VMEM budget
-            per = (n_bufs + 1) * fm_pallas._padded_bytes((tb, f, d))
-            assert 2 * per <= 6 * 1024 * 1024 or tb <= 8
+            # double-buffered blocks stay under the VMEM budget
+            assert 2 * 3 * tb * bytes_per_row <= 6 * 1024 * 1024 or tb <= 8
