@@ -61,6 +61,27 @@ def ftrl_solve(z, n, lr, l1, l2, beta):
     )
 
 
+# Dense-delta optimizer updates: (sum g, sum g^2, *state tables) -> new
+# tables.  The ONE elementwise copy shared by the shard_map wrappers below
+# and train.shardmap_step (the K2 kernels fuse the same formulas in-kernel,
+# via the shared ftrl_solve).
+def adagrad_update(g1, g2, table, acc, *, lr, eps):
+    acc_new = acc + g2
+    return table - lr * g1 * jax.lax.rsqrt(acc_new + eps), acc_new
+
+
+def ftrl_update(g1, g2, table, z, n, *, lr, l1, l2, beta):
+    n_new = n + g2
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g1 - sigma * table
+    return ftrl_solve(z_new, n_new, lr, l1, l2, beta), z_new, n_new
+
+
+def sgd_update(g1, g2, table, *, lr):
+    del g2
+    return (table - lr * g1,)
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -433,11 +454,7 @@ def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
 def adagrad_apply_sharded(table, acc, ids, g_rows, *, lr, eps, mesh,
                           data_axis, model_axis):
     def update(g1, g2, table_l, acc_l):
-        acc_new = acc_l + g2
-        return (
-            table_l - lr * g1 * jax.lax.rsqrt(acc_new + eps),
-            acc_new,
-        )
+        return adagrad_update(g1, g2, table_l, acc_l, lr=lr, eps=eps)
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table, acc), ids, g_rows,
@@ -448,8 +465,7 @@ def adagrad_apply_sharded(table, acc, ids, g_rows, *, lr, eps, mesh,
 def sgd_apply_sharded(table, ids, g_rows, *, lr, mesh, data_axis,
                       model_axis):
     def update(g1, g2, table_l):
-        del g2
-        return table_l - lr * g1
+        return sgd_update(g1, g2, table_l, lr=lr)[0]
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table,), ids, g_rows,
@@ -460,10 +476,9 @@ def sgd_apply_sharded(table, ids, g_rows, *, lr, mesh, data_axis,
 def ftrl_apply_sharded(table, z, n, ids, g_rows, *, lr, l1, l2, beta, mesh,
                        data_axis, model_axis):
     def update(g1, g2, table_l, z_l, n_l):
-        n_new = n_l + g2
-        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n_l)) / lr
-        z_new = z_l + g1 - sigma * table_l
-        return ftrl_solve(z_new, n_new, lr, l1, l2, beta), z_new, n_new
+        return ftrl_update(
+            g1, g2, table_l, z_l, n_l, lr=lr, l1=l1, l2=l2, beta=beta
+        )
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table, z, n), ids, g_rows,

@@ -101,13 +101,35 @@ def make_sparse_train_step(cfg: FmConfig, mesh=None):
     """Sparse train step: optimizer touches only the batch's rows
     (train.sparse — the IndexedSlices path, SURVEY.md §3.2).  The mesh is
     threaded through so the Pallas kernel runs under shard_map (Mosaic
-    kernels cannot be auto-partitioned by GSPMD)."""
+    kernels cannot be auto-partitioned by GSPMD).
+
+    ``lookup = shardmap`` on a multi-device mesh selects the hand-sharded
+    step (train.shardmap_step): partial-terms psum instead of row
+    gathering, closed-form local backward, dense-delta allreduce."""
+    from fast_tffm_tpu.train import shardmap_step
+
+    use_shardmap = (
+        cfg.lookup == "shardmap"
+        and mesh is not None
+        and mesh.size > 1
+    )
+    if use_shardmap and not shardmap_step.supports_shardmap(cfg, mesh):
+        raise ValueError(
+            "lookup=shardmap needs plain FM (field_num=0), optimizer in "
+            "adagrad/ftrl/sgd, batch-mode L2, and a vocabulary divisible "
+            f"by model_shards*{sparse_lib.sparse_apply.TILE}"
+        )
 
     def step(state: TrainState, batch: Batch) -> TrainState:
-        params, opt_state, scores = sparse_lib.sparse_step(
-            cfg, state.params, state.opt_state, batch,
-            mesh=mesh, data_axis=mesh_lib.DATA_AXIS,
-        )
+        if use_shardmap:
+            params, opt_state, scores = shardmap_step.sparse_step_shardmap(
+                cfg, state.params, state.opt_state, batch, mesh
+            )
+        else:
+            params, opt_state, scores = sparse_lib.sparse_step(
+                cfg, state.params, state.opt_state, batch,
+                mesh=mesh, data_axis=mesh_lib.DATA_AXIS,
+            )
         ms = _metric_update(
             state.metrics, scores, batch.labels, batch.weights, cfg.loss_type
         )

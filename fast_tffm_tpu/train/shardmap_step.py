@@ -1,0 +1,210 @@
+"""Explicit shard_map sparse train step — `lookup = shardmap`.
+
+The GSPMD-auto path (train.sparse under jit with shardings) lets XLA pick
+the collectives for ``table[ids]`` with a row-sharded table; depending on
+shapes that can materialize gathered rows across shards.  This module is
+the hand-laid-out alternative, exploiting FM's algebra (SURVEY.md §7 step
+4, models.fm.interaction_terms docstring):
+
+  * The per-example terms (linear, s1, s2) are SUMS of per-feature
+    contributions, and each feature's contribution depends only on the row
+    its id owns.  So each model shard computes partial terms from ITS rows
+    and a psum over the model axis of [b, 2k+1] floats replaces the whole
+    row exchange — per-step model-axis traffic is ~KB where a gathered-row
+    exchange is ~MB-GB.  This is the PS architecture inverted: row owners
+    compute, examples aggregate.
+  * The backward is the closed-form FmGrad (SURVEY.md §3.4): dV = g*x*(s1
+    - v*x) needs only the psum'd s1 plus the shard's own rows — each
+    shard computes gradients for exactly the occurrences it owns, locally.
+  * Updates: per-shard dense (sum g, sum g^2) deltas via ops.sparse_apply's
+    K1+K-place kernels, psum'd over the data axis (the sync-DP gradient
+    allreduce), then the optimizer formula applied elementwise in place.
+
+Scope: plain FM with the sparse row-local optimizers (adagrad/ftrl/sgd)
+and batch-mode (or zero) L2.  FFM and dense optimizers stay on the
+GSPMD-auto path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.libsvm import Batch
+from fast_tffm_tpu.models import fm
+from fast_tffm_tpu.ops import sparse_apply
+from fast_tffm_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from fast_tffm_tpu.train.sparse import (
+    ADAGRAD_EPS,
+    SparseAdagradState,
+    SparseFtrlState,
+)
+
+
+def supports_shardmap(cfg: FmConfig, mesh) -> bool:
+    if cfg.field_num:
+        return False
+    if cfg.optimizer not in ("adagrad", "ftrl", "sgd"):
+        return False
+    if cfg.l2_mode != "batch" and (cfg.factor_lambda or cfg.bias_lambda):
+        return False
+    model_shards = mesh.shape[MODEL_AXIS]
+    return sparse_apply.supports_tile_sharded(
+        cfg.vocabulary_size, cfg.optimizer, model_shards
+    )
+
+
+def _dscore(scores, labels, loss_type):
+    if loss_type == "logistic":
+        return jax.nn.sigmoid(scores) - labels
+    return 2.0 * (scores - labels)  # mse
+
+
+def _opt_tables(cfg: FmConfig, opt_state):
+    if cfg.optimizer == "adagrad":
+        return (opt_state.acc.table,)
+    if cfg.optimizer == "ftrl":
+        return (opt_state.z.table, opt_state.n.table)
+    return ()
+
+
+def _rebuild_opt(cfg: FmConfig, opt_state, new_tables, dw0, w0_old):
+    lr = cfg.learning_rate
+    if cfg.optimizer == "adagrad":
+        acc_w0 = opt_state.acc.w0 + dw0 * dw0
+        w0 = w0_old - lr * dw0 * jax.lax.rsqrt(acc_w0 + ADAGRAD_EPS)
+        return w0, SparseAdagradState(
+            acc=fm.FmParams(w0=acc_w0, table=new_tables[0])
+        )
+    if cfg.optimizer == "ftrl":
+        n0_new = opt_state.n.w0 + dw0 * dw0
+        sigma0 = (jnp.sqrt(n0_new) - jnp.sqrt(opt_state.n.w0)) / lr
+        z0 = opt_state.z.w0 + dw0 - sigma0 * w0_old
+        w0 = sparse_apply.ftrl_solve(
+            z0, n0_new, lr, cfg.ftrl_l1, cfg.ftrl_l2, cfg.ftrl_beta
+        )
+        return w0, SparseFtrlState(
+            z=fm.FmParams(w0=z0, table=new_tables[0]),
+            n=fm.FmParams(w0=n0_new, table=new_tables[1]),
+        )
+    return w0_old - lr * dw0, opt_state  # sgd
+
+
+def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
+                         mesh):
+    """One sparse train step, hand-sharded. Returns (params, opt, scores)."""
+    model_shards = mesh.shape[MODEL_AXIS]
+    vocab_local = cfg.vocabulary_size // model_shards
+    k = cfg.factor_num
+    n_opt = len(_opt_tables(cfg, opt_state))
+
+    def device_fn(w0, table_l, labels, ids, vals, weights, *opt_tables_l):
+        m = jax.lax.axis_index(MODEL_AXIS)
+        row_lo = m * vocab_local
+        local = (ids >= row_lo) & (ids < row_lo + vocab_local)  # [b, F]
+        lids = jnp.where(local, ids - row_lo, 0)
+        maskf = local.astype(jnp.float32)
+        rows = table_l[lids] * maskf[..., None]  # [b, F, D], 0 off-shard
+        w = rows[..., 0]
+        v = rows[..., 1:]
+        xv = v * vals[..., None]
+        # Partial terms from this shard's rows; psum over model completes
+        # them — the entire "lookup" is this [b, 2k+1] collective.
+        terms = jnp.concatenate(
+            [
+                jnp.sum(w * vals, axis=-1, keepdims=True),  # linear
+                jnp.sum(xv, axis=1),  # s1 [b, k]
+                jnp.sum(xv * xv, axis=1),  # s2 [b, k]
+            ],
+            axis=-1,
+        )
+        terms = jax.lax.psum(terms, MODEL_AXIS)
+        linear, s1, s2 = terms[:, 0], terms[:, 1:1 + k], terms[:, 1 + k:]
+        scores = w0 + linear + 0.5 * jnp.sum(s1 * s1 - s2, axis=-1)
+        # Global weighted-mean loss: normalizer spans the data axis.
+        wsum = jax.lax.psum(jnp.sum(weights), DATA_AXIS)
+        g = weights * _dscore(scores, labels, cfg.loss_type) / jnp.maximum(
+            wsum, 1e-12
+        )  # [b] dL/dscore
+        # Closed-form FmGrad for the occurrences this shard owns.
+        gx = g[:, None] * vals * maskf  # [b, F]
+        dv = gx[..., None] * (s1[:, None, :] - xv)  # [b, F, k]
+        drows = jnp.concatenate([gx[..., None], dv], axis=-1)  # [b, F, D]
+        if cfg.factor_lambda or cfg.bias_lambda:
+            # d/drow of l2_penalty_batch: 2*lambda*row/B per occurrence.
+            bsz = jax.lax.psum(jnp.float32(vals.shape[0]), DATA_AXIS)
+            lam = jnp.concatenate([
+                jnp.full((1,), cfg.bias_lambda, jnp.float32),
+                jnp.full((k,), cfg.factor_lambda, jnp.float32),
+            ])
+            occ = (vals != 0).astype(jnp.float32)[..., None] * maskf[..., None]
+            drows = drows + (2.0 / bsz) * lam * rows * occ
+        # Local-coordinate occurrence list; off-shard -> sentinel row.
+        b, f = vals.shape
+        ids_flat = jnp.where(local, ids - row_lo, vocab_local).reshape(b * f)
+        g_flat = drows.reshape(b * f, 1 + k)
+        delta = sparse_apply.dense_delta(
+            ids_flat.astype(jnp.int32), g_flat,
+            vocab=vocab_local, vocab_local=vocab_local, row_lo=0,
+        )
+        delta = jax.lax.psum(delta, DATA_AXIS)
+        d = 1 + k
+        dw0 = jax.lax.psum(jnp.sum(g), DATA_AXIS)
+        if cfg.bias_lambda:
+            # l2_penalty_batch includes bias_lambda*w0^2/B — its w0 grad
+            # must land here too or w0 diverges from the scatter path.
+            bsz_g = jax.lax.psum(jnp.float32(vals.shape[0]), DATA_AXIS)
+            dw0 = dw0 + 2.0 * cfg.bias_lambda * w0 / bsz_g
+        w_new, new_tables = _apply_delta(
+            cfg, delta[:, :d], delta[:, d:], table_l, opt_tables_l
+        )
+        return (w_new, scores, dw0) + tuple(new_tables)
+
+    out_specs = (
+        (P(MODEL_AXIS, None), P(DATA_AXIS), P())
+        + (P(MODEL_AXIS, None),) * n_opt
+    )
+    outs = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(
+            (P(), P(MODEL_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None),
+             P(DATA_AXIS, None), P(DATA_AXIS))
+            + (P(MODEL_AXIS, None),) * n_opt
+        ),
+        out_specs=out_specs,
+        check_vma=False,  # pallas_call outputs carry no vma annotations
+    )(
+        params.w0, params.table, batch.labels, batch.ids, batch.vals,
+        batch.weights, *_opt_tables(cfg, opt_state),
+    )
+    table_new, scores, dw0 = outs[0], outs[1], outs[2]
+    new_opt_tables = outs[3:]
+    w0_new, opt_new = _rebuild_opt(
+        cfg, opt_state, new_opt_tables, dw0, params.w0
+    )
+    return fm.FmParams(w0=w0_new, table=table_new), opt_new, scores
+
+
+def _apply_delta(cfg, g1, g2, w_l, opt_tables_l):
+    """Optimizer update on (table shard, opt-table shards) -> new tables.
+
+    Delegates to ops.sparse_apply's shared elementwise update functions so
+    all sharded paths stay bit-identical.
+    """
+    lr = cfg.learning_rate
+    if cfg.optimizer == "adagrad":
+        w_new, acc_new = sparse_apply.adagrad_update(
+            g1, g2, w_l, opt_tables_l[0], lr=lr, eps=ADAGRAD_EPS
+        )
+        return w_new, (acc_new,)
+    if cfg.optimizer == "ftrl":
+        w_new, z_new, n_new = sparse_apply.ftrl_update(
+            g1, g2, w_l, *opt_tables_l,
+            lr=lr, l1=cfg.ftrl_l1, l2=cfg.ftrl_l2, beta=cfg.ftrl_beta,
+        )
+        return w_new, (z_new, n_new)
+    (w_new,) = sparse_apply.sgd_update(g1, g2, w_l, lr=lr)
+    return w_new, ()
