@@ -78,23 +78,34 @@ def ffm_scores_from_rows(
     field_num: int,
     compute_dtype=jnp.float32,
 ) -> jax.Array:
-    """Field-aware FM: score = w0 + sum w_i x_i + sum_{i<j} <v_{i,f_j}, v_{j,f_i}> x_i x_j."""
+    """Field-aware FM: score = w0 + sum w_i x_i + sum_{i<j} <v_{i,f_j}, v_{j,f_i}> x_i x_j.
+
+    MXU-friendly field-grouped form (no per-example gathers): with
+    S[b,p,q,:] = sum_{i: f_i = p} v_i^q * x_i (a batched one-hot matmul),
+
+        sum_{i != j} <v_i^{f_j}, v_j^{f_i}> x_i x_j
+            = sum_{p,q} <S[p,q], S[q,p]> - sum_i <v_i^{f_i}, v_i^{f_i}> x_i^2
+
+    and the strict-upper-triangle sum is half of that.  This replaces the
+    naive [B,F,F,k] pairwise tensor (a ~800MB intermediate at Criteo
+    shapes, built by row gathers) with two einsum-matmuls over [B,P,P,k].
+    """
     rows = rows.astype(compute_dtype)
     vals = vals.astype(compute_dtype)
     b, f = vals.shape
     w = rows[..., 0]
-    v = rows[..., 1:].reshape(b, f, field_num, factor_num)  # [B,F,Fl,k]
+    v = rows[..., 1:].reshape(b, f, field_num, factor_num)  # [B,F,P,k]
     linear = jnp.sum(w * vals, axis=-1)
-    # v_sel[b, i, j, :] = v[b, i, fields[b, j], :]
-    v_sel = jax.vmap(
-        lambda vb, fb: vb[:, fb, :]  # [F,Fl,k] indexed by [F] -> [F,F,k]
-    )(v, fields)
-    inter_full = jnp.einsum("bijk,bjik->bij", v_sel, v_sel)  # <v_{i,f_j}, v_{j,f_i}>
-    xx = vals[:, :, None] * vals[:, None, :]  # [B,i,j]
-    pair = inter_full * xx
-    # Strict upper triangle: i < j (no self-interactions in FFM).
-    iu = jnp.triu(jnp.ones((f, f), bool), k=1)
-    inter = jnp.sum(jnp.where(iu[None], pair, 0.0), axis=(1, 2))
+    oh = (
+        fields[..., None] == jnp.arange(field_num, dtype=fields.dtype)
+    ).astype(compute_dtype)  # [B, F, P] pure field one-hot
+    s = jnp.einsum("bfp,bfqk->bpqk", oh * vals[..., None], v)
+    cross = jnp.einsum("bpqk,bqpk->b", s, s)
+    v_own = jnp.einsum("bfq,bfqk->bfk", oh, v)  # v_i^{f_i}
+    self_term = jnp.sum(
+        jnp.sum(v_own * v_own, axis=-1) * vals * vals, axis=-1
+    )
+    inter = 0.5 * (cross - self_term)
     return w0 + linear + inter
 
 

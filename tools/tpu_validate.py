@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""One-shot TPU validation + timing sweep for the Pallas paths.
+
+Run on the real chip (one TPU process at a time!):
+
+    python tools/tpu_validate.py [--quick]
+
+Sections:
+  1. correctness: flat fwd/bwd kernels + tile sparse apply vs XLA oracle
+  2. component timings: sort / perm / cumsum / K1 / K2 / fwd+bwd
+  3. step timings: full train step under scatter vs tile apply
+
+All timings force completion with scalar readbacks (block_until_ready
+under-reports through the remote tunnel; see bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def drain(tree) -> None:
+    import jax
+
+    for leaf in jax.tree.leaves(tree):
+        np.asarray(jax.device_get(leaf.reshape(-1)[:1] if hasattr(leaf, "reshape") else leaf))
+
+
+def bench(fn, *args, steps=20):
+    for _ in range(2):
+        drain(fn(*args))
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(steps):
+        r = fn(*args)
+    drain(r)
+    return (time.perf_counter() - t0) * 1e3 / steps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from fast_tffm_tpu.ops import fm_pallas, interaction, sparse_apply
+
+    print("devices:", jax.devices(), flush=True)
+    on_tpu = jax.default_backend() == "tpu"
+    print("backend:", jax.default_backend(), flush=True)
+
+    B, F, K = (4096, 39, 8) if args.quick else (16384, 39, 8)
+    D = 1 + K
+    V = 1 << 22
+    rng = np.random.default_rng(0)
+
+    # ---- 1. correctness ------------------------------------------------
+    rows = jax.device_put(
+        jnp.asarray(rng.uniform(-0.1, 0.1, (B, F, D)), jnp.float32))
+    vals = jax.device_put(
+        jnp.asarray(rng.uniform(0.1, 1.0, (B, F)), jnp.float32))
+    g = jax.device_put(jnp.asarray(rng.uniform(-1, 1, (B,)), jnp.float32))
+
+    sc_p, s1_p = fm_pallas.fm_scores_pallas(rows, vals, interpret=not on_tpu)
+    sc_o, s1_o = jax.jit(interaction._scores_jnp)(rows, vals)
+    err_f = float(jnp.max(jnp.abs(sc_p - sc_o)))
+    dr_p = fm_pallas.fm_grad_pallas(rows, vals, s1_p, g, interpret=not on_tpu)
+    dr_o = jax.jit(interaction._grads_jnp)(rows, vals, s1_o, g)
+    err_b = float(jnp.max(jnp.abs(dr_p - dr_o)))
+    print(f"fwd kernel max err: {err_f:.3e}  bwd: {err_b:.3e}", flush=True)
+    assert err_f < 1e-4 and err_b < 1e-4, "KERNEL MISMATCH"
+
+    N = B * F
+    ids = jax.device_put(
+        jnp.asarray(rng.integers(0, V, (N,)), jnp.int32))
+    g_rows = jax.device_put(
+        jnp.asarray(rng.uniform(-1e-2, 1e-2, (N, D)), jnp.float32))
+    table = jax.device_put(
+        jnp.asarray(rng.uniform(-0.1, 0.1, (V, D)), jnp.float32))
+    acc = jnp.full((V, D), 0.1, jnp.float32)
+    lr, eps = 0.05, 1e-7
+
+    t_tile, a_tile = jax.jit(
+        lambda t, a, i, gg: sparse_apply.adagrad_apply(
+            t, a, i, gg, lr=lr, eps=eps)
+    )(table, acc, ids, g_rows)
+    a_ref = acc.at[ids].add(g_rows * g_rows)
+    t_ref = table.at[ids].add(
+        -lr * g_rows * jax.lax.rsqrt(a_ref[ids] + eps))
+    terr = float(jnp.max(jnp.abs(t_tile - t_ref)))
+    aerr = float(jnp.max(jnp.abs(a_tile - a_ref)))
+    print(f"tile adagrad max err: table {terr:.3e} acc {aerr:.3e}", flush=True)
+    assert terr < 1e-4, "TILE APPLY MISMATCH"
+
+    # ---- 2. component timings -----------------------------------------
+    iota = jnp.arange(N, dtype=jnp.int32)
+    t = {}
+    t["sort_key_val"] = bench(
+        jax.jit(lambda i: jax.lax.sort_key_val(i, iota)), ids)
+    perm = jax.device_put(jnp.asarray(rng.permutation(N), jnp.int32))
+    t["perm_gather"] = bench(jax.jit(lambda gg, p: gg[p]), g_rows, perm)
+    t["cumsum"] = bench(
+        jax.jit(lambda i: jnp.cumsum((i != 0).astype(jnp.int32))), ids)
+    t["fwd_pallas"] = bench(
+        lambda r, v: fm_pallas.fm_scores_pallas(r, v, interpret=not on_tpu),
+        rows, vals)
+    t["fwd_jnp"] = bench(jax.jit(interaction._scores_jnp), rows, vals)
+    t["bwd_pallas"] = bench(
+        lambda r, v, s, gg: fm_pallas.fm_grad_pallas(
+            r, v, s, gg, interpret=not on_tpu), rows, vals, s1_p, g)
+    t["bwd_jnp"] = bench(jax.jit(interaction._grads_jnp), rows, vals, s1_o, g)
+    t["tile_adagrad_apply"] = bench(
+        jax.jit(lambda tb, a, i, gg: sparse_apply.adagrad_apply(
+            tb, a, i, gg, lr=lr, eps=eps)), table, acc, ids, g_rows)
+    t["scatter_adagrad_apply"] = bench(
+        jax.jit(lambda tb, a, i, gg: (
+            lambda an: (tb.at[i].add(-lr * gg * jax.lax.rsqrt(an[i] + eps)),
+                        an))(a.at[i].add(gg * gg))),
+        table, acc, ids, g_rows)
+    t["gather_2d"] = bench(
+        jax.jit(lambda tb, i: tb[i]), table,
+        jax.device_put(jnp.asarray(
+            rng.integers(0, V, (B, F)), jnp.int32)))
+    for k_, v_ in t.items():
+        print(f"  {k_:24s} {v_:9.3f} ms", flush=True)
+
+    # ---- 3. full steps -------------------------------------------------
+    import shutil
+
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.data.libsvm import Batch
+    from fast_tffm_tpu.train.loop import Trainer
+
+    for mode in ("scatter", "tile"):
+        for use_pallas in (False, True):
+            cfg = FmConfig(
+                vocabulary_size=V, factor_num=K, max_features=F,
+                batch_size=B, learning_rate=0.05, log_steps=0,
+                sparse_apply=mode, use_pallas=use_pallas,
+                model_file=f"/tmp/tpuval_{mode}_{int(use_pallas)}",
+            )
+            shutil.rmtree(cfg.model_file, ignore_errors=True)
+            trainer = Trainer(cfg)
+            batches = []
+            for _ in range(4):
+                batches.append(trainer._put(Batch(
+                    labels=rng.integers(0, 2, (B,)).astype(np.float32),
+                    ids=rng.integers(0, V, (B, F)).astype(np.int32),
+                    vals=rng.uniform(0.1, 1.0, (B, F)).astype(np.float32),
+                    fields=np.zeros((B, F), np.int32),
+                    weights=np.ones((B,), np.float32),
+                )))
+
+            # rotate batches without host sync
+            def run_n(n, trainer=trainer, batches=batches):
+                for i in range(n):
+                    trainer.state = trainer._train_step(
+                        trainer.state, batches[i % 4])
+                return trainer.state
+
+            drain(run_n(3))
+            steps = 10 if args.quick else 30
+            t0 = time.perf_counter()
+            st = run_n(steps)
+            drain((st.metrics.loss_sum, st.params.table[0, 0], st.step))
+            dt = time.perf_counter() - t0
+            ms = dt * 1e3 / steps
+            print(json.dumps({
+                "step": f"sparse_apply={mode} use_pallas={use_pallas}",
+                "ms_per_step": round(ms, 2),
+                "examples_per_sec": round(B * steps / dt, 1),
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
