@@ -1,17 +1,28 @@
 #!/usr/bin/env python
-"""Benchmark: FM train-step throughput on a Criteo-like workload.
+"""Benchmark: end-to-end FM training throughput on a Criteo-like workload.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N, ...}
 
 Baseline: the driver target of 2M examples/sec aggregate on a v5e-16
 (BASELINE.md) = 125k examples/sec/chip; ``vs_baseline`` is the per-chip
 ratio vs that target, scaled by the number of chips actually used.
 
-Workload: 2nd-order FM, batch 16384, 39 features/example (Criteo layout),
-factor_num 8, vocab 2^22 hash buckets — full train step (forward, backward,
-sparse Adagrad update, metrics) with device-resident batches, steady-state
-timed.
+Headline metric (the judged one): END-TO-END examples/sec — libsvm text
+files generated on disk, parsed by the native C++ parser through
+BatchPipeline (host threads overlapping device steps), trained with the
+full sparse train step.  Feature ids are Zipf(1.1)-skewed then
+hash-spread, matching CTR data's duplicate structure (which stresses the
+dedup/carry chain in the sparse apply path) rather than uniform ids.
+Also reported: device-step-only throughput (ingest excluded) and the
+parse-only rate, so the ingest-vs-compute split is visible.
+
+Robustness: the TPU tunnel on this machine ('axon' PJRT plugin, dialed by
+a global sitecustomize) can be down or slow to init.  The backend is
+probed in a SUBPROCESS with bounded retries + backoff (a failed in-process
+init poisons jax's backend cache); if the tunnel never comes up the bench
+falls back to CPU with an ``error`` note — the JSON line is emitted either
+way so the driver always gets a parseable record.
 
 Timing note: completion is forced by reading back scalars that depend on
 both the metrics chain and the updated table.  ``block_until_ready`` alone
@@ -21,13 +32,90 @@ executions drain), which would inflate throughput ~1000x.
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import shutil
+import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
 
 PER_CHIP_TARGET = 2_000_000 / 16  # BASELINE.md: 2M ex/s on v5e-16
+_PROBE_MARK = "BENCH_PROBE_OK"
+
+
+def _probe_backend(attempts: int = 3, timeout: int = 240):
+    """Probe the default jax backend in a subprocess (retry + backoff).
+
+    Returns (platform, n_devices, error_note).  platform is None if no
+    backend (other than forcing CPU) could be brought up.
+    """
+    code = (
+        "import jax; d = jax.devices(); "
+        f"print('{_PROBE_MARK}', d[0].platform, len(d))"
+    )
+    last_err = ""
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            for line in out.stdout.splitlines():
+                if line.startswith(_PROBE_MARK):
+                    _, plat, n = line.split()
+                    return plat, int(n), None
+            last_err = (out.stderr or out.stdout).strip()[-300:]
+        except subprocess.TimeoutExpired:
+            last_err = f"backend probe timed out after {timeout}s"
+        if i + 1 < attempts:
+            time.sleep(5 * (i + 1))
+    return None, 0, f"backend unavailable after {attempts} probes: {last_err}"
+
+
+def _zipf_ids(rng, shape, vocab: int) -> np.ndarray:
+    """Zipf(1.1)-skewed ids hash-spread over the bucket space: realistic
+    CTR duplicate structure (a few very hot ids) without clustering the
+    hot ids into adjacent buckets."""
+    z = rng.zipf(1.1, size=shape).astype(np.uint64)
+    return ((z * np.uint64(0x9E3779B97F4A7C15)) % np.uint64(vocab)).astype(
+        np.int32
+    )
+
+
+def _gen_libsvm_files(tmpdir: str, rng, n_files: int, lines_per_file: int,
+                      n_feat: int, vocab: int) -> list[str]:
+    """Vectorized libsvm text generation: numpy bytes ops, pairwise-reduced
+    concatenation (a left-fold over 39 growing columns copies quadratically;
+    pure-Python per-token formatting would take minutes at multi-chip
+    batch sizes)."""
+    paths = []
+    for fi in range(n_files):
+        ids = _zipf_ids(rng, (lines_per_file, n_feat), vocab)
+        # vals in [0.1, 1.0) with 4 decimals, formatted as "0.%04d".
+        val4 = rng.integers(1000, 10000, size=(lines_per_file, n_feat))
+        labels = rng.integers(0, 2, size=(lines_per_file,))
+        cols = [labels.astype("S1")]
+        for j in range(n_feat):
+            cols.append(np.char.add(
+                np.char.add(b" ", np.char.add(ids[:, j].astype("S10"), b":0.")),
+                val4[:, j].astype("S4"),
+            ))
+        while len(cols) > 1:  # log-depth reduce
+            nxt = [np.char.add(cols[i], cols[i + 1])
+                   for i in range(0, len(cols) - 1, 2)]
+            if len(cols) % 2:
+                nxt.append(cols[-1])
+            cols = nxt
+        path = os.path.join(tmpdir, f"bench_{fi}.libsvm")
+        with open(path, "wb") as f:
+            f.write(b"\n".join(cols[0]))
+            f.write(b"\n")
+        paths.append(path)
+    return paths
 
 
 def _drain(state) -> float:
@@ -38,67 +126,187 @@ def _drain(state) -> float:
     return s
 
 
-def main() -> int:
-    import jax
-
-    from fast_tffm_tpu.config import FmConfig
+def _make_batch(rng, cfg, vocab: int):
     from fast_tffm_tpu.data.libsvm import Batch
-    from fast_tffm_tpu.train.loop import Trainer
 
-    devices = jax.devices()
-    n_chips = len(devices)
-    platform = devices[0].platform
-
-    cfg = FmConfig(
-        vocabulary_size=1 << 22,
-        factor_num=8,
-        max_features=39,
-        batch_size=16384 * max(1, n_chips),
-        learning_rate=0.05,
-        model_file="/tmp/fast_tffm_tpu_bench_model",
-        log_steps=0,
+    return Batch(
+        labels=rng.integers(0, 2, size=(cfg.batch_size,)).astype(np.float32),
+        ids=_zipf_ids(rng, (cfg.batch_size, cfg.max_features), vocab),
+        vals=rng.uniform(
+            0.1, 1.0, size=(cfg.batch_size, cfg.max_features)
+        ).astype(np.float32),
+        fields=np.zeros((cfg.batch_size, cfg.max_features), np.int32),
+        weights=np.ones((cfg.batch_size,), np.float32),
     )
-    import shutil
 
-    shutil.rmtree(cfg.model_file, ignore_errors=True)
-    trainer = Trainer(cfg)
 
+def _bench_step_only(trainer, cfg, steps: int) -> float:
     rng = np.random.default_rng(0)
-    n_batches = 4  # rotate a few so no cross-step result reuse
-    batches = []
-    for _ in range(n_batches):
-        b = Batch(
-            labels=rng.integers(0, 2, size=(cfg.batch_size,)).astype(np.float32),
-            ids=rng.integers(0, cfg.vocabulary_size,
-                             size=(cfg.batch_size, cfg.max_features)).astype(np.int32),
-            vals=rng.uniform(0.1, 1.0,
-                             size=(cfg.batch_size, cfg.max_features)).astype(np.float32),
-            fields=np.zeros((cfg.batch_size, cfg.max_features), np.int32),
-            weights=np.ones((cfg.batch_size,), np.float32),
-        )
-        batches.append(trainer._put(b))
-
-    # Warmup: compile + a few steps, fully drained.
+    batches = [trainer._put(_make_batch(rng, cfg, cfg.vocabulary_size))
+               for _ in range(4)]
     for i in range(3):
-        trainer.state = trainer._train_step(trainer.state, batches[i % n_batches])
+        trainer.state = trainer._train_step(trainer.state, batches[i % 4])
     _drain(trainer.state)
-
-    steps = 50
     t0 = time.perf_counter()
     for i in range(steps):
-        trainer.state = trainer._train_step(trainer.state, batches[i % n_batches])
+        trainer.state = trainer._train_step(trainer.state, batches[i % 4])
+    _drain(trainer.state)
+    return steps * cfg.batch_size / (time.perf_counter() - t0)
+
+
+def _bench_parse_only(files, cfg) -> float:
+    """Raw native-parser rate on the generated files (single pass, the
+    internally-threaded parse_raw fast path)."""
+    from fast_tffm_tpu.data import native as native_lib
+    from fast_tffm_tpu.data.pipeline import _iter_raw_groups
+
+    try:
+        parser = native_lib.NativeParser(
+            cfg.vocabulary_size, cfg.max_features, cfg.hash_feature_id,
+            cfg.field_num, cfg.thread_num,
+        )
+    except Exception:  # pragma: no cover - env-dependent
+        return 0.0
+    n = 0
+    t0 = time.perf_counter()
+    for buf, offsets in _iter_raw_groups(files, cfg.batch_size):
+        parser.parse_raw(buf, offsets, cfg.batch_size)
+        n += len(offsets) - 1
+    dt = time.perf_counter() - t0
+    return n / dt if dt > 0 else 0.0
+
+
+def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int) -> float:
+    """Examples/sec through BatchPipeline (ingest + train overlapped)."""
+    from fast_tffm_tpu.data.pipeline import BatchPipeline
+
+    pipeline = BatchPipeline(files, cfg, epochs=epochs, shuffle=True)
+    it = iter(pipeline)
+    for _ in range(warmup):
+        b = next(it)
+        trainer.state = trainer._train_step(trainer.state, trainer._put(b))
+    _drain(trainer.state)
+    n = 0
+    t0 = time.perf_counter()
+    for b in it:
+        trainer.state = trainer._train_step(trainer.state, trainer._put(b))
+        n += int(np.sum(b.weights > 0))
     _drain(trainer.state)
     dt = time.perf_counter() - t0
+    return n / dt if dt > 0 else 0.0
 
-    ex_per_sec = steps * cfg.batch_size / dt
-    per_chip = ex_per_sec / n_chips
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["e2e", "step"], default="e2e")
+    ap.add_argument("--steps", type=int, default=50)
+    args = ap.parse_args()
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        platform, n_chips, err = None, 0, None
+    else:
+        platform, n_chips, err = _probe_backend()
+    if platform is None or platform == "cpu":
+        # Tunnel down, or the probe itself already fell back to CPU: pin
+        # CPU in-process too, otherwise backend init re-dials the axon
+        # tunnel and can hang unboundedly.
+        from fast_tffm_tpu.platform import pin_cpu
+
+        import jax
+
+        pin_cpu()
+        platform, n_chips = "cpu", len(jax.devices())
+
+    on_tpu = platform not in ("cpu",)
+    step_rate, e2e_rate, parse_rate = 0.0, 0.0, 0.0
+    e2e_err = None
+    cfg = None
+    try:
+        from fast_tffm_tpu.config import FmConfig
+        from fast_tffm_tpu.train.loop import Trainer
+
+        cfg = FmConfig(
+            vocabulary_size=1 << 22 if on_tpu else 1 << 20,
+            factor_num=8,
+            max_features=39,
+            batch_size=(16384 if on_tpu else 4096) * max(1, n_chips),
+            learning_rate=0.05,
+            model_file="/tmp/fast_tffm_tpu_bench_model",
+            log_steps=0,
+            thread_num=min(16, max(4, (os.cpu_count() or 4) - 2)),
+            # Small queues: with deep queues the parser threads can finish
+            # the whole (finite) dataset during warmup and the "e2e" timed
+            # region would measure dequeue-only throughput, not ingest.
+            queue_size=2,
+        )
+        shutil.rmtree(cfg.model_file, ignore_errors=True)
+        trainer = Trainer(cfg)
+
+        steps = args.steps if on_tpu else min(args.steps, 10)
+        step_rate = _bench_step_only(trainer, cfg, steps)
+
+        if args.mode == "e2e":
+            try:
+                tmpdir = tempfile.mkdtemp(prefix="fast_tffm_bench_")
+                try:
+                    rng = np.random.default_rng(7)
+                    # 8 full GLOBAL batches per epoch (scales with chip
+                    # count so no partial zero-padded groups distort the
+                    # judged number).
+                    n_files = 4
+                    lines_per_file = 2 * cfg.batch_size
+                    files = _gen_libsvm_files(
+                        tmpdir, rng, n_files, lines_per_file,
+                        cfg.max_features, cfg.vocabulary_size,
+                    )
+                    parse_rate = _bench_parse_only(files, cfg)
+                    batches_per_epoch = n_files * lines_per_file // cfg.batch_size
+                    # Timed region must be >> the max in-flight buffer
+                    # (work + out queues + one batch per parser thread),
+                    # else the timed loop mostly drains batches pre-parsed
+                    # during warmup and overstates ingest throughput.
+                    inflight = cfg.thread_num + 2 * cfg.queue_size + 2
+                    want_batches = 4 + max(
+                        64 if on_tpu else 24, 5 * inflight
+                    )
+                    epochs = max(2, -(-want_batches // batches_per_epoch))
+                    e2e_rate = _bench_e2e(
+                        trainer, cfg, files, warmup=4, epochs=epochs
+                    )
+                finally:
+                    shutil.rmtree(tmpdir, ignore_errors=True)
+            except Exception as e:  # noqa: BLE001 — always emit the JSON line
+                e2e_err = f"e2e bench failed: {type(e).__name__}: {e}"
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        e2e_err = f"bench failed: {type(e).__name__}: {e}"
+
+    headline = e2e_rate if e2e_rate > 0 else step_rate
+    kind = "e2e" if e2e_rate > 0 else "step_only"
+    ingest_note = (
+        "libsvm ingest via native parser" if kind == "e2e"
+        else "device-resident batches, no ingest"
+    )
+    per_chip = headline / max(1, n_chips)
+    bdesc = cfg.batch_size if cfg else 0
+    vdesc = cfg.vocabulary_size.bit_length() - 1 if cfg else 0
     result = {
-        "metric": f"fm_train_examples_per_sec ({platform} x{n_chips}, "
-                  f"B={cfg.batch_size}, F=39, k=8, vocab=2^22)",
-        "value": round(ex_per_sec, 1),
+        "metric": (
+            f"fm_train_examples_per_sec_{kind} ({platform} x{n_chips}, "
+            f"B={bdesc}, F=39, k=8, vocab=2^{vdesc}, zipf1.1 ids, "
+            f"{ingest_note})"
+        ),
+        "value": round(headline, 1),
         "unit": "examples/sec",
         "vs_baseline": round(per_chip / PER_CHIP_TARGET, 4),
+        "step_only_examples_per_sec": round(step_rate, 1),
+        "e2e_examples_per_sec": round(e2e_rate, 1),
+        "parse_lines_per_sec": round(parse_rate, 1),
+        "platform": platform,
+        "n_chips": n_chips,
     }
+    notes = [n for n in (err, e2e_err) if n]
+    if notes:
+        result["error"] = "; ".join(notes)
     print(json.dumps(result))
     return 0
 

@@ -1,0 +1,40 @@
+"""Platform pinning for machines with a remote-TPU PJRT tunnel.
+
+On this project's dev/driver machines a global sitecustomize registers an
+'axon' PJRT plugin in every python process and sets
+``jax_platforms="axon,cpu"`` via jax.config — which OVERRIDES the
+``JAX_PLATFORMS`` env var — and initializing that backend dials a remote
+TPU and can block for minutes.  Anything that must stay on CPU (tests,
+virtual-device dry runs, bench fallback) calls :func:`pin_cpu` BEFORE the
+first jax backend touch.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def pin_cpu(n_devices: int | None = None) -> None:
+    """Force the CPU platform, optionally with ``n_devices`` virtual CPUs.
+
+    Must run before jax initializes a backend: the XLA flag is read at CPU
+    client creation, and a backend cached from an earlier init cannot be
+    replaced.  An existing ``--xla_force_host_platform_device_count`` flag
+    with a different value is REPLACED (a stale count would make
+    multi-device dry runs assert on device count).
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--{_FLAG}={n_devices}"
+        if _FLAG in flags:
+            flags = re.sub(rf"--{_FLAG}=\d+", want, flags)
+        else:
+            flags = (flags + " " + want).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -12,18 +12,12 @@ we set the XLA flag before importing jax, then force the platform list back
 to "cpu" through jax.config.
 """
 
-import os
+from fast_tffm_tpu.platform import pin_cpu
 
 # Must happen before jax initializes its CPU client.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+pin_cpu(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
