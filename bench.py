@@ -70,7 +70,11 @@ def _probe_backend(attempts: int = 3, timeout: int = 240):
                     return plat, int(n), None
             last_err = (out.stderr or out.stdout).strip()[-300:]
         except subprocess.TimeoutExpired:
-            last_err = f"backend probe timed out after {timeout}s"
+            # A hung tunnel won't unhang in a few seconds — retrying at
+            # full timeout would burn the driver's wall-clock budget, so
+            # short-circuit straight to the CPU fallback.  Retries are for
+            # fast transient errors only.
+            return None, 0, f"backend probe timed out after {timeout}s"
         if i + 1 < attempts:
             time.sleep(5 * (i + 1))
     return None, 0, f"backend unavailable after {attempts} probes: {last_err}"

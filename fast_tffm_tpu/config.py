@@ -81,6 +81,10 @@ class FmConfig:
     shuffle_buffer: int = 10000
     save_steps: int = 0  # 0 = only at end of training
     log_steps: int = 100
+    # Run validation every N steps during training (0 = only at the end)
+    # — the reference printed periodic step/loss/validation-loss
+    # (SURVEY.md §5 metrics row).
+    validation_steps: int = 0
     seed: int = 0
 
     # --- [Predict] ---
@@ -192,6 +196,7 @@ _KEYMAP = {
     "shuffle_buffer": ("shuffle_buffer", int),
     "save_steps": ("save_steps", int),
     "log_steps": ("log_steps", int),
+    "validation_steps": ("validation_steps", int),
     "seed": ("seed", int),
     "predict_files": ("predict_files", _parse_files),
     "score_path": ("score_path", str),

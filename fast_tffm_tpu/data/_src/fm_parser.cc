@@ -78,17 +78,20 @@ inline bool ParseInt(const char* s, const char* e, int64_t* out) {
     ++s;
   }
   if (s >= e) return false;
+  // Skip leading zeros so only SIGNIFICANT digits count toward the cap —
+  // Python's int() accepts "000...0123" and so must we (bit-exactness with
+  // the oracle).  At least one digit remains semantically: all-zero input
+  // falls through with v == 0, digits == 0.
+  while (s < e && *s == '0') ++s;
   uint64_t v = 0;
   int digits = 0;
   for (; s < e; ++s) {
     char c = *s;
     if (c < '0' || c > '9') return false;
-    // Past 19 digits int64 overflows; match Python int() by wrapping like
-    // a checked strtoll would — reject only on true overflow.
+    // 19 significant digits max 9999999999999999999 < 2^64, so v never
+    // wraps; the int64 limit check below is the real range guard.
     if (++digits > 19) return false;
-    uint64_t nv = v * 10 + (c - '0');
-    if (digits == 19 && nv / 10 != v) return false;  // overflow
-    v = nv;
+    v = v * 10 + (c - '0');
   }
   uint64_t limit = neg ? (1ull << 63) : (1ull << 63) - 1;
   if (v > limit) return false;

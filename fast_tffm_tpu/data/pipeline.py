@@ -202,6 +202,7 @@ class BatchPipeline:
         drop_remainder: bool = False,
         seed: Optional[int] = None,
         ordered: bool = False,
+        skip_batches: int = 0,
     ):
         self.files = list(files)
         self.cfg = cfg
@@ -210,6 +211,12 @@ class BatchPipeline:
         self.shuffle = shuffle
         self.drop_remainder = drop_remainder
         self.seed = cfg.seed if seed is None else seed
+        # Mid-epoch resume: skip the first N batches of epoch 0 WITHOUT
+        # parsing them.  Skipping happens after shuffling, so the stream
+        # continues exactly where a run with the same seed left off (batch
+        # delivery order across >1 parser threads remains nondeterministic,
+        # like the reference's async queues).
+        self.skip_batches = skip_batches
         # ordered=True forces one parser thread so batches come out in
         # input order (the predict path needs score/line alignment).
         self.ordered = ordered
@@ -258,6 +265,7 @@ class BatchPipeline:
             try:
                 for epoch in range(self.epochs):
                     rng = random.Random(self.seed + epoch)
+                    to_skip = self.skip_batches if epoch == 0 else 0
                     if self._raw:
                         it = _iter_raw_groups(self.files, cfg.batch_size)
                         if self.shuffle:  # group-granularity shuffle
@@ -271,6 +279,9 @@ class BatchPipeline:
                         if stop.is_set():
                             return
                         if self.drop_remainder and _item_len(item) < cfg.batch_size:
+                            continue
+                        if to_skip > 0:
+                            to_skip -= 1
                             continue
                         if not put_checked(work, item):
                             return

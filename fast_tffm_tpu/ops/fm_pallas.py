@@ -134,15 +134,27 @@ def _bwd_kernel(rows_ref, vals_ref, s1_ref, g_ref, drows_ref, *, f, d):
     drows_ref[...] = (g * xe) * (s1e - y * maskv)
 
 
+def _pad_batch(b: int) -> int:
+    """Round B up to a multiple of 128.  ``_block_b`` picks tile sizes from
+    the divisors of B, so a prime or non-8-multiple batch would silently
+    degenerate to 1-row blocks (a B-step grid); padding guarantees
+    sublane-aligned divisors at a cost of <128 wasted rows."""
+    return -(-b // 128) * 128
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fm_scores_pallas(rows: jax.Array, vals: jax.Array, interpret: bool = False):
     """Forward: (scores [B], s1 [B, K]) from gathered rows [B, F, D]."""
     b, f, d = rows.shape
     fd = f * d
     rows2 = rows.reshape(b, fd)  # free bitcast: same dense layout
+    bp = _pad_batch(b)
+    if bp != b:
+        rows2 = jnp.pad(rows2, ((0, bp - b), (0, 0)))
+        vals = jnp.pad(vals, ((0, bp - b), (0, 0)))
     bytes_per_row = 4 * (2 * _pad128(fd) + _pad128(f))
-    tb = _block_b(b, bytes_per_row)
-    grid = (b // tb,)
+    tb = _block_b(bp, bytes_per_row)
+    grid = (bp // tb,)
     scores, s1 = pl.pallas_call(
         functools.partial(_fwd_kernel, f=f, d=d),
         grid=grid,
@@ -155,12 +167,12 @@ def fm_scores_pallas(rows: jax.Array, vals: jax.Array, interpret: bool = False):
             pl.BlockSpec((tb, d - 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, 1), rows.dtype),
-            jax.ShapeDtypeStruct((b, d - 1), rows.dtype),
+            jax.ShapeDtypeStruct((bp, 1), rows.dtype),
+            jax.ShapeDtypeStruct((bp, d - 1), rows.dtype),
         ],
         interpret=interpret,
     )(rows2, vals)
-    return scores[:, 0], s1
+    return scores[:b, 0], s1[:b]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -175,9 +187,16 @@ def fm_grad_pallas(
     b, f, d = rows.shape
     fd = f * d
     rows2 = rows.reshape(b, fd)
+    dscores2 = dscores[:, None]
+    bp = _pad_batch(b)
+    if bp != b:
+        rows2 = jnp.pad(rows2, ((0, bp - b), (0, 0)))
+        vals = jnp.pad(vals, ((0, bp - b), (0, 0)))
+        s1 = jnp.pad(s1, ((0, bp - b), (0, 0)))
+        dscores2 = jnp.pad(dscores2, ((0, bp - b), (0, 0)))
     bytes_per_row = 4 * (3 * _pad128(fd) + _pad128(f))
-    tb = _block_b(b, bytes_per_row)
-    grid = (b // tb,)
+    tb = _block_b(bp, bytes_per_row)
+    grid = (bp // tb,)
     drows = pl.pallas_call(
         functools.partial(_bwd_kernel, f=f, d=d),
         grid=grid,
@@ -188,7 +207,7 @@ def fm_grad_pallas(
             pl.BlockSpec((tb, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((tb, fd), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, fd), rows.dtype),
+        out_shape=jax.ShapeDtypeStruct((bp, fd), rows.dtype),
         interpret=interpret,
-    )(rows2, vals, s1, dscores[:, None])
-    return drows.reshape(b, f, d)
+    )(rows2, vals, s1, dscores2)
+    return drows[:b].reshape(b, f, d)

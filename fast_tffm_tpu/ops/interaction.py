@@ -18,8 +18,7 @@ import jax.numpy as jnp
 from fast_tffm_tpu.ops import fm_pallas
 
 
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from fast_tffm_tpu.platform import use_interpret as _use_interpret
 
 
 def _scores_jnp(rows, vals):

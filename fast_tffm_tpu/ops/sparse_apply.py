@@ -82,8 +82,7 @@ def sgd_update(g1, g2, table, *, lr):
     return (table - lr * g1,)
 
 
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from fast_tffm_tpu.platform import use_interpret as _use_interpret
 
 
 def supports_tile(vocab: int, optimizer: str) -> bool:

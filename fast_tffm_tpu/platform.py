@@ -11,10 +11,40 @@ first jax backend touch.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 
+log = logging.getLogger(__name__)
+
 _FLAG = "xla_force_host_platform_device_count"
+
+# Platform names that mean "a real TPU runs the Mosaic kernels".  The
+# remote tunnel's PJRT plugin registers as 'axon' but serves a TPU; gating
+# on the literal "tpu" alone would silently leave Pallas kernels in
+# interpret mode (orders of magnitude slower) on the tunnel.
+_TPU_PLATFORMS = frozenset({"tpu", "axon"})
+
+
+def is_tpu_backend() -> bool:
+    """True when the default jax backend executes on a TPU (directly or via
+    the tunnel plugin).  Used to gate Pallas-vs-interpret and the
+    tile-vs-scatter sparse apply choice."""
+    import jax
+
+    if jax.default_backend() in _TPU_PLATFORMS:
+        return True
+    try:
+        return jax.devices()[0].platform in _TPU_PLATFORMS
+    except RuntimeError:  # no backend at all
+        return False
+
+
+def use_interpret() -> bool:
+    """Pallas kernels run in interpret mode off-TPU (correctness tool; far
+    slower than compiled Mosaic).  The ONE gate all kernel call sites
+    share."""
+    return not is_tpu_backend()
 
 
 def pin_cpu(n_devices: int | None = None) -> None:

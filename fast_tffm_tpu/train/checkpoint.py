@@ -16,6 +16,7 @@ without ever being gathered to one host.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 from typing import Any, Optional
@@ -35,11 +36,16 @@ def _opt_dir(model_file: str) -> str:
     return os.path.join(os.path.abspath(model_file), "opt")
 
 
+def _data_state_path(model_file: str) -> str:
+    return os.path.join(os.path.abspath(model_file), "data_state.json")
+
+
 def save(
     model_file: str,
     step: int,
     params: Any,
     opt_state: Any = None,
+    data_state: Optional[dict] = None,
 ) -> None:
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(
@@ -49,7 +55,24 @@ def save(
         )
         if opt_state is not None:
             ckptr.save(_opt_dir(model_file), {"opt_state": opt_state}, force=True)
+    if data_state is not None:
+        # Input-pipeline position (epoch, batches consumed) for mid-epoch
+        # resume; written last so a crash mid-save leaves the (older)
+        # params without a newer data position.
+        tmp = _data_state_path(model_file) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data_state, f)
+        os.replace(tmp, _data_state_path(model_file))
     log.info("saved checkpoint step=%d to %s", step, model_file)
+
+
+def restore_data_state(model_file: str) -> Optional[dict]:
+    """The saved input-pipeline position, or None (old/absent checkpoint)."""
+    try:
+        with open(_data_state_path(model_file)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
 
 
 def exists(model_file: str) -> bool:

@@ -190,6 +190,9 @@ class Trainer:
     def __init__(self, cfg: FmConfig, mesh=None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
+        # Input-pipeline position for checkpointed mid-epoch resume.
+        self._epoch = 0
+        self._batches_done = 0
         self.sparse = bool(cfg.sparse_update) and sparse_lib.supports_sparse(cfg)
         if cfg.sparse_update and not self.sparse:
             log.info(
@@ -226,6 +229,19 @@ class Trainer:
             make_sparse_train_step(cfg, self.mesh)
             if self.sparse
             else make_train_step(cfg, self.optimizer)
+        )
+        # Visible record of the chosen execution strategy: a silent
+        # fallback (e.g. interpret-mode Pallas on an unrecognized
+        # platform) is orders of magnitude slower, so surface it once.
+        from fast_tffm_tpu.platform import use_interpret
+
+        log.info(
+            "step build: sparse=%s apply_mode=%s pallas=%s interpret=%s "
+            "backend=%s mesh=%s",
+            self.sparse,
+            sparse_lib.apply_mode(cfg, self.mesh) if self.sparse else "dense",
+            cfg.use_pallas, use_interpret(), jax.default_backend(),
+            dict(self.mesh.shape),
         )
         self._train_step = jax.jit(
             step_fn,
@@ -292,13 +308,27 @@ class Trainer:
         cfg = self.cfg
         if not cfg.train_files:
             raise ValueError("no train_files configured")
-        pipeline = BatchPipeline(
-            cfg.train_files,
-            cfg,
-            weight_files=cfg.weight_files or None,
-            epochs=cfg.epoch_num,
-            shuffle=True,
+        # Mid-epoch resume: a checkpoint carries the input-pipeline position
+        # (epoch, batches consumed).  With the same seed/files, the stream
+        # continues where the interrupted run stopped instead of replaying
+        # the epoch from scratch.  A completed run's position (epoch ==
+        # epoch_num) means a warm start trains epoch_num fresh epochs.
+        resume_epoch, resume_skip = 0, 0
+        # Only resume the data position when params actually warm-started —
+        # a stale data_state.json next to cleared params must not make a
+        # fresh model skip training data.
+        ds = (
+            checkpoint.restore_data_state(cfg.model_file)
+            if self._restored_step else None
         )
+        if ds is not None and 0 <= ds.get("epoch", -1) < cfg.epoch_num:
+            resume_epoch = int(ds["epoch"])
+            resume_skip = int(ds.get("batches_done", 0))
+            if resume_epoch or resume_skip:
+                log.info(
+                    "resuming data stream at epoch %d, skipping %d batches",
+                    resume_epoch, resume_skip,
+                )
         metrics_out = (
             open(cfg.metrics_file, "a") if cfg.metrics_file else None
         )
@@ -308,41 +338,73 @@ class Trainer:
         seen = 0.0
         stepno = 0
         try:
-            for batch in pipeline:
-                if cfg.profile_dir and stepno == cfg.profile_start_step:
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    profiling = True
-                self.state = self._train_step(self.state, self._put(batch))
-                stepno += 1
-                if profiling and stepno >= (
-                    cfg.profile_start_step + cfg.profile_steps
-                ):
-                    jax.block_until_ready(self.state)
-                    jax.profiler.stop_trace()
-                    profiling = False
-                    log.info("profiler trace written to %s", cfg.profile_dir)
-                seen += float(np.sum(batch.weights > 0))
-                if cfg.log_steps and stepno % cfg.log_steps == 0:
-                    m = _finalize_metrics(self.state.metrics, cfg.loss_type)
-                    now = time.time()
-                    rate = (seen - last_log_ex) / max(now - last_log_t, 1e-9)
-                    last_log_t, last_log_ex = now, seen
-                    log.info(
-                        "step %d examples %d loss %.6f auc %.4f ex/s %.0f",
-                        stepno, int(seen), m["loss"], m["auc"], rate,
-                    )
-                    if metrics_out is not None:
-                        metrics_out.write(json.dumps({
-                            "step": stepno,
-                            "examples": seen,
-                            "loss": m["loss"],
-                            "auc": m["auc"],
-                            "examples_per_sec": rate,
-                            "elapsed": now - t0,
-                        }) + "\n")
-                        metrics_out.flush()
-                if cfg.save_steps and stepno % cfg.save_steps == 0:
-                    self.save(stepno)
+            for epoch in range(resume_epoch, cfg.epoch_num):
+                self._epoch = epoch
+                self._batches_done = resume_skip if epoch == resume_epoch else 0
+                pipeline = BatchPipeline(
+                    cfg.train_files,
+                    cfg,
+                    weight_files=cfg.weight_files or None,
+                    epochs=1,
+                    shuffle=True,
+                    seed=cfg.seed + epoch,
+                    skip_batches=self._batches_done,
+                )
+                for batch in pipeline:
+                    if cfg.profile_dir and stepno == cfg.profile_start_step:
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        profiling = True
+                    self.state = self._train_step(self.state, self._put(batch))
+                    stepno += 1
+                    self._batches_done += 1
+                    if profiling and stepno >= (
+                        cfg.profile_start_step + cfg.profile_steps
+                    ):
+                        jax.block_until_ready(self.state)
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        log.info("profiler trace written to %s", cfg.profile_dir)
+                    seen += float(np.sum(batch.weights > 0))
+                    if cfg.log_steps and stepno % cfg.log_steps == 0:
+                        m = _finalize_metrics(self.state.metrics, cfg.loss_type)
+                        now = time.time()
+                        rate = (seen - last_log_ex) / max(now - last_log_t, 1e-9)
+                        last_log_t, last_log_ex = now, seen
+                        log.info(
+                            "step %d examples %d loss %.6f auc %.4f ex/s %.0f",
+                            stepno, int(seen), m["loss"], m["auc"], rate,
+                        )
+                        if metrics_out is not None:
+                            metrics_out.write(json.dumps({
+                                "step": stepno,
+                                "examples": seen,
+                                "loss": m["loss"],
+                                "auc": m["auc"],
+                                "examples_per_sec": rate,
+                                "elapsed": now - t0,
+                            }) + "\n")
+                            metrics_out.flush()
+                    if (
+                        cfg.validation_steps
+                        and cfg.validation_files
+                        and stepno % cfg.validation_steps == 0
+                    ):
+                        vm = self.evaluate(cfg.validation_files)
+                        log.info(
+                            "step %d validation loss %.6f auc %.4f",
+                            stepno, vm["loss"], vm["auc"],
+                        )
+                        if metrics_out is not None:
+                            metrics_out.write(json.dumps({
+                                "step": stepno,
+                                "validation_loss": vm["loss"],
+                                "validation_auc": vm["auc"],
+                            }) + "\n")
+                            metrics_out.flush()
+                    if cfg.save_steps and stepno % cfg.save_steps == 0:
+                        self.save(stepno)
+            self._epoch = cfg.epoch_num
+            self._batches_done = 0
         finally:
             # An abandoned trace poisons any later start_trace in-process.
             if profiling:
@@ -377,6 +439,10 @@ class Trainer:
             self._restored_step + stepno,
             self.state.params,
             self.state.opt_state,
+            data_state={
+                "epoch": self._epoch,
+                "batches_done": self._batches_done,
+            },
         )
 
 

@@ -70,7 +70,9 @@ def apply_mode(cfg: FmConfig, mesh=None) -> str:
         return tiled  # explicit: run even off-TPU (interpret mode, tests)
     # auto: only where the Mosaic kernels actually run (TPU) — interpret
     # mode on CPU is a correctness tool, far slower than XLA scatter.
-    if ok and jax.default_backend() == "tpu":
+    from fast_tffm_tpu.platform import is_tpu_backend
+
+    if ok and is_tpu_backend():
         return tiled
     return "scatter"
 

@@ -1,0 +1,78 @@
+"""Metrics correctness: the histogram AUC must match an exact pairwise
+AUC computation (the project is judged on AUC parity — BASELINE.md — so a
+binning bug that shifts AUC by a point must not survive the suite)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from fast_tffm_tpu.train import metrics as metrics_lib
+
+
+def _exact_pairwise_auc(scores, labels, weights):
+    """Brute-force weighted AUC: P(score_pos > score_neg) + 0.5 ties,
+    weighted by w_pos * w_neg."""
+    p, wp = scores[labels == 1], weights[labels == 1]
+    n, wn = scores[labels == 0], weights[labels == 0]
+    cmp = (p[:, None] > n[None, :]).astype(np.float64)
+    cmp += 0.5 * (p[:, None] == n[None, :])
+    return float(
+        (wp[:, None] * wn[None, :] * cmp).sum() / (wp.sum() * wn.sum())
+    )
+
+
+def _stream_auc(scores, labels, weights, chunk=1000):
+    st = metrics_lib.auc_init()
+    for i in range(0, len(scores), chunk):
+        st = metrics_lib.auc_update(
+            st,
+            jnp.asarray(scores[i:i + chunk], jnp.float32),
+            jnp.asarray(labels[i:i + chunk], jnp.float32),
+            jnp.asarray(weights[i:i + chunk], jnp.float32),
+        )
+    return float(metrics_lib.auc_finalize(st))
+
+
+def test_auc_matches_exact_pairwise(rng):
+    for trial in range(3):
+        b = 4000
+        scores = rng.normal(0, 1.5, b)
+        # Labels correlated with scores so AUC is far from 0.5.
+        prob = 1.0 / (1.0 + np.exp(-0.8 * scores))
+        labels = (rng.uniform(size=b) < prob).astype(np.float32)
+        weights = rng.uniform(0.2, 2.0, b).astype(np.float32)
+        got = _stream_auc(scores, labels, weights)
+        want = _exact_pairwise_auc(scores, labels, weights)
+        # 1024 sigmoid bins: discretization error only.
+        assert abs(got - want) < 2e-3, (trial, got, want)
+
+
+def test_auc_weight_zero_rows_ignored(rng):
+    b = 1000
+    scores = rng.normal(0, 1, b)
+    labels = (rng.uniform(size=b) < 0.4).astype(np.float32)
+    weights = np.ones(b, np.float32)
+    base = _stream_auc(scores, labels, weights)
+    # Append adversarial rows with weight 0 (padded examples).
+    scores2 = np.concatenate([scores, np.full(200, 5.0)])
+    labels2 = np.concatenate([labels, np.zeros(200, np.float32)])
+    weights2 = np.concatenate([weights, np.zeros(200, np.float32)])
+    np.testing.assert_allclose(
+        _stream_auc(scores2, labels2, weights2), base, atol=1e-6
+    )
+
+
+def test_auc_degenerate_single_class():
+    """All-positive / all-negative streams must not produce NaN."""
+    scores = np.linspace(-1, 1, 100)
+    ones = np.ones(100, np.float32)
+    for labels in (np.ones(100, np.float32), np.zeros(100, np.float32)):
+        got = _stream_auc(scores, labels, ones)
+        assert np.isfinite(got) and 0.0 <= got <= 1.0
+
+
+def test_auc_perfect_and_antiperfect_separation():
+    scores = np.concatenate([np.full(50, -4.0), np.full(50, 4.0)])
+    labels = np.concatenate([np.zeros(50), np.ones(50)]).astype(np.float32)
+    ones = np.ones(100, np.float32)
+    assert _stream_auc(scores, labels, ones) > 0.999
+    assert _stream_auc(scores, 1.0 - labels, ones) < 0.001

@@ -115,6 +115,26 @@ def test_native_long_ids_match_python_int_semantics(built):
     np.testing.assert_array_equal(got.ids, want.ids)
 
 
+def test_native_zero_padded_tokens_match_oracle(built):
+    """Leading zeros must not count toward the digit cap: Python's int()
+    accepts '000...0123' so the native parser must too (labels, fields,
+    and ids alike)."""
+    pad = "0" * 25
+    cases = [
+        f"{pad}1 {pad}42:1.5",            # padded label + padded id
+        f"1 {pad}7:{pad}2:1.0",           # padded field (ffm form)
+        f"0 {'0' * 30}:1.0",              # all-zero id of absurd length
+    ]
+    parser = native.NativeParser(1000, 4, field_num=3, num_threads=1)
+    got = parser.parse_batch(cases, batch_size=3)
+    exs = libsvm.parse_lines(cases, 1000, field_num=3)
+    want = libsvm.make_batch(exs, 3, 4)
+    np.testing.assert_array_equal(got.labels, want.labels)
+    np.testing.assert_array_equal(got.ids, want.ids)
+    np.testing.assert_array_equal(got.fields, want.fields)
+    np.testing.assert_array_equal(got.vals, want.vals)
+
+
 def test_native_vocab_size_bounds(built):
     with pytest.raises(ValueError, match="out of range"):
         native.NativeParser(1 << 60, 4)

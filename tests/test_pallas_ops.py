@@ -88,3 +88,30 @@ def test_block_b_divides():
             assert b % tb == 0
             # double-buffered blocks stay under the VMEM budget
             assert 2 * 3 * tb * bytes_per_row <= 6 * 1024 * 1024 or tb <= 8
+
+
+@pytest.mark.parametrize("b", [7, 1000, 1009])
+def test_pallas_kernels_odd_batch_sizes(rng, b):
+    """Prime / non-8-multiple batches pad to sane block sizes instead of
+    degenerating to 1-row blocks — and still match the oracle exactly."""
+    f, k = 13, 8
+    rows = jnp.asarray(rng.normal(size=(b, f, 1 + k)).astype(np.float32) * 0.3)
+    vals = jnp.asarray(rng.normal(size=(b, f)).astype(np.float32))
+    # The padded batch keeps sublane-aligned tiles.
+    bp = fm_pallas._pad_batch(b)
+    assert bp % 128 == 0
+    tb = fm_pallas._block_b(bp, 4 * (2 * fm_pallas._pad128(f * (1 + k))
+                                     + fm_pallas._pad128(f)))
+    assert tb % 8 == 0
+
+    scores_p, s1_p = fm_pallas.fm_scores_pallas(rows, vals, interpret=True)
+    scores_o, s1_o = interaction._scores_jnp(rows, vals)
+    assert scores_p.shape == (b,)
+    np.testing.assert_allclose(np.asarray(scores_p), np.asarray(scores_o),
+                               rtol=1e-5, atol=1e-6)
+    g = jnp.asarray(rng.normal(size=(b,)).astype(np.float32))
+    drows_p = fm_pallas.fm_grad_pallas(rows, vals, s1_p, g, interpret=True)
+    drows_o = interaction._grads_jnp(rows, vals, s1_o, g)
+    assert drows_p.shape == (b, f, 1 + k)
+    np.testing.assert_allclose(np.asarray(drows_p), np.asarray(drows_o),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,127 @@
+"""Mid-epoch resume + periodic validation (SURVEY.md §5: checkpoint row
+"resumable mid-epoch via data-iterator state"; metrics row "periodic
+step/loss/validation-loss prints")."""
+
+import json
+
+import numpy as np
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.libsvm import Batch
+from fast_tffm_tpu.data.pipeline import BatchPipeline
+from fast_tffm_tpu.train import checkpoint
+from fast_tffm_tpu.train.loop import Trainer
+
+
+def _write_data(path, rng, lines=256, vocab=64):
+    with open(path, "w") as f:
+        for i in range(lines):
+            f.write(
+                f"{i % 2} {rng.integers(0, vocab)}:1 "
+                f"{rng.integers(0, vocab)}:0.5\n"
+            )
+
+
+def _cfg(tmp_path, **kw):
+    defaults = dict(
+        vocabulary_size=64, factor_num=4, max_features=4, batch_size=32,
+        train_files=[str(tmp_path / "train.libsvm")],
+        model_file=str(tmp_path / "model"),
+        epoch_num=1, log_steps=0, thread_num=1, seed=3,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+def _batch_key(b: Batch):
+    return (b.labels.tobytes(), b.ids.tobytes(), b.vals.tobytes())
+
+
+def test_pipeline_skip_batches_continues_stream(tmp_path, rng):
+    """skip=k with the same seed must yield exactly the full stream minus
+    its first k batches (single parser thread for determinism)."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path)
+    full = [
+        _batch_key(b)
+        for b in BatchPipeline(cfg.train_files, cfg, epochs=1, shuffle=True)
+    ]
+    assert len(full) == 8
+    skipped = [
+        _batch_key(b)
+        for b in BatchPipeline(
+            cfg.train_files, cfg, epochs=1, shuffle=True, skip_batches=3
+        )
+    ]
+    assert skipped == full[3:]
+
+
+def test_trainer_resumes_mid_epoch(tmp_path, rng):
+    """A checkpoint carrying a pipeline position makes train() continue
+    from that batch instead of replaying the epoch."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path)
+    r1 = Trainer(cfg).train()
+    assert r1["train"]["steps"] == 8
+
+    # Simulate an interruption at batch 5 of epoch 0: rewrite the saved
+    # data position (params/opt stay as saved).
+    ds = checkpoint.restore_data_state(cfg.model_file)
+    assert ds == {"epoch": 1, "batches_done": 0}  # completed run
+    with open(f"{cfg.model_file}/data_state.json", "w") as f:
+        json.dump({"epoch": 0, "batches_done": 5}, f)
+
+    t2 = Trainer(cfg)
+    assert t2._restored_step == 8  # warm start from the checkpoint
+    r2 = t2.train()
+    assert r2["train"]["steps"] == 3  # only the remaining 3 batches
+    assert checkpoint.restore_data_state(cfg.model_file) == {
+        "epoch": 1, "batches_done": 0,
+    }
+
+
+def test_stale_data_state_ignored_without_params(tmp_path, rng):
+    """Clearing the params to retrain from scratch must not let a
+    surviving data_state.json truncate the fresh run's stream."""
+    import shutil
+
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path)
+    Trainer(cfg).train()
+    with open(f"{cfg.model_file}/data_state.json", "w") as f:
+        json.dump({"epoch": 0, "batches_done": 5}, f)
+    shutil.rmtree(f"{cfg.model_file}/params")
+    shutil.rmtree(f"{cfg.model_file}/opt")
+    r = Trainer(cfg).train()
+    assert r["train"]["steps"] == 8  # full epoch, nothing skipped
+
+
+def test_completed_checkpoint_warm_starts_full_epochs(tmp_path, rng):
+    """Warm-starting from a COMPLETED run trains epoch_num fresh epochs
+    (the Adagrad-vs-FTRL sweep relies on this)."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path)
+    Trainer(cfg).train()
+    r2 = Trainer(cfg).train()
+    assert r2["train"]["steps"] == 8
+
+
+def test_periodic_validation(tmp_path, rng):
+    _write_data(tmp_path / "train.libsvm", rng)
+    _write_data(tmp_path / "valid.libsvm", rng, lines=64)
+    cfg = _cfg(
+        tmp_path,
+        validation_files=[str(tmp_path / "valid.libsvm")],
+        validation_steps=3,
+        metrics_file=str(tmp_path / "metrics.jsonl"),
+    )
+    result = Trainer(cfg).train()
+    recs = [json.loads(line)
+            for line in open(tmp_path / "metrics.jsonl")]
+    vrecs = [r for r in recs if "validation_loss" in r]
+    # 8 steps, validation every 3 -> steps 3 and 6.
+    assert [r["step"] for r in vrecs] == [3, 6]
+    for r in vrecs:
+        assert np.isfinite(r["validation_loss"])
+        assert 0.0 <= r["validation_auc"] <= 1.0
+    assert "validation" in result  # final validation still runs
