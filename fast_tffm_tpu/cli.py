@@ -91,18 +91,9 @@ def main(argv=None) -> int:
     _setup_logging(cfg.log_file or None)
     dist = _resolve_dist(args)
     if dist is not None:
-        import jax
+        from fast_tffm_tpu.train import dist as dist_lib
 
-        coordinator, nproc, pid = dist
-        log.info(
-            "initializing jax.distributed: %s (%d processes, this is %d)",
-            coordinator, nproc, pid,
-        )
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=nproc,
-            process_id=pid,
-        )
+        dist_lib.initialize(*dist)
 
     from fast_tffm_tpu.train.loop import Trainer, predict
 

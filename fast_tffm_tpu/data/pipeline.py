@@ -180,6 +180,30 @@ def _item_len(item) -> int:
     return len(item)
 
 
+def _strided_rounds(it, shard_id: int, num_shards: int):
+    """Yield every num_shards-th item, but only from COMPLETE rounds.
+
+    Multi-host input sharding: shard s takes items s, n+s, 2n+s, ... of the
+    (identically seeded, hence identical) global stream.  Every shard must
+    emit the SAME number of items — a host running one extra step would
+    deadlock the others in the step's collectives — so an item is held back
+    until its round is known complete (an item of the next round arrives)
+    and the tail round is dropped at EOF if partial.
+    """
+    pending = None  # (round, item) candidate from this shard's slot
+    last_idx = -1
+    for idx, item in enumerate(it):
+        last_idx = idx
+        r = idx // num_shards
+        if pending is not None and r > pending[0]:
+            yield pending[1]
+            pending = None
+        if idx % num_shards == shard_id:
+            pending = (r, item)
+    if pending is not None and last_idx >= pending[0] * num_shards + num_shards - 1:
+        yield pending[1]
+
+
 class BatchPipeline:
     """Background-threaded parse/batch pipeline.
 
@@ -203,6 +227,7 @@ class BatchPipeline:
         seed: Optional[int] = None,
         ordered: bool = False,
         skip_batches: int = 0,
+        shard: tuple[int, int] = (0, 1),
     ):
         self.files = list(files)
         self.cfg = cfg
@@ -217,6 +242,12 @@ class BatchPipeline:
         # delivery order across >1 parser threads remains nondeterministic,
         # like the reference's async queues).
         self.skip_batches = skip_batches
+        # Multi-host input sharding (shard_id, num_shards): this pipeline
+        # emits only its strided share of the global stream, round-complete
+        # (see _strided_rounds).  Skip counts apply AFTER sharding.
+        if not (0 <= shard[0] < shard[1]):
+            raise ValueError(f"bad shard {shard}")
+        self.shard = shard
         # ordered=True forces one parser thread so batches come out in
         # input order (the predict path needs score/line alignment).
         self.ordered = ordered
@@ -275,11 +306,19 @@ class BatchPipeline:
                             it = _shuffled(it, buffer, rng)
                     else:
                         it = _line_chunks(rng)
+                    if self.drop_remainder:
+                        # Filter BEFORE sharding so all shards see the same
+                        # global item indexing (a partial group dropped by
+                        # one host only would desync step counts).
+                        it = (
+                            x for x in it
+                            if _item_len(x) >= cfg.batch_size
+                        )
+                    if self.shard[1] > 1:
+                        it = _strided_rounds(it, *self.shard)
                     for item in it:
                         if stop.is_set():
                             return
-                        if self.drop_remainder and _item_len(item) < cfg.batch_size:
-                            continue
                         if to_skip > 0:
                             to_skip -= 1
                             continue

@@ -77,8 +77,61 @@ def shard_params(params: FmParams, mesh: Mesh) -> FmParams:
     return jax.tree.map(jax.device_put, params, sh)
 
 
+def data_partition(mesh: Mesh) -> tuple[int, int]:
+    """This process's (block_index, num_blocks) of the data-axis partition.
+
+    Multi-host input sharding (SURVEY.md §7 hard-part 2): each process
+    parses only its own slice of the global batch, so the data axis must
+    partition across processes in equal contiguous blocks — true for the
+    default jax.distributed device order (devices grouped by process) and
+    this module's row-major (data, model) grid.  num_blocks is the number
+    of distinct data blocks; processes that share a block (model-axis-
+    spanning processes) read the same input shard.
+    """
+    import jax
+
+    arr = mesh.devices  # [data, model] ndarray of Devices
+    pid = jax.process_index()
+    mine = [
+        i for i in range(arr.shape[0])
+        if any(d.process_index == pid for d in arr[i])
+    ]
+    if not mine:
+        raise ValueError("this process owns no devices on the data axis")
+    k = len(mine)
+    n_data = arr.shape[0]
+    if mine != list(range(mine[0], mine[0] + k)) or mine[0] % k or n_data % k:
+        raise ValueError(
+            "data-axis rows owned by this process must form an aligned "
+            f"contiguous block (got rows {mine} of {n_data}); use the "
+            "default device order or reshape the mesh so each process's "
+            "devices are contiguous along the data axis"
+        )
+    return mine[0] // k, n_data // k
+
+
 def shard_batch(batch, mesh: Mesh):
+    """Ship a host batch to the mesh.
+
+    Single-process: device_put each array with its (data, model) sharding.
+    Multi-process: ``batch`` holds only this process's LOCAL slice
+    (global_batch / num_blocks rows); the global array is assembled with
+    ``jax.make_array_from_process_local_data`` — the GSPMD replacement for
+    feeding per-worker input queues (SURVEY.md §3.2), with no host ever
+    materializing the global batch.
+    """
     sh = batch_sharding(mesh)
+    if jax.process_count() > 1:
+        _, num_blocks = data_partition(mesh)
+
+        def put(x, s):
+            x = np.asarray(x)
+            global_shape = (x.shape[0] * num_blocks,) + x.shape[1:]
+            return jax.make_array_from_process_local_data(s, x, global_shape)
+
+        return type(batch)(
+            *(put(getattr(batch, k), sh[k]) for k in batch._fields)
+        )
     return type(batch)(
         *(jax.device_put(getattr(batch, k), sh[k]) for k in batch._fields)
     )

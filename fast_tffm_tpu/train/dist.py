@@ -1,0 +1,42 @@
+"""`dist_train` bring-up — the reference's PS cluster, TPU-style.
+
+The reference's ``dist_train.py`` built a ``tf.train.ClusterSpec`` of ps +
+worker tasks and parked ps processes serving variable blocks (SURVEY.md
+§3.2).  Here there are no parameter servers: every process runs the SAME
+training command after :func:`initialize`, and
+
+- the embedding/factor table row-shards over the global (data, model) mesh
+  (``parallel.mesh``) — GSPMD inserts the collectives the PS gather/scatter
+  used to be,
+- each host parses only its slice of the input stream
+  (``BatchPipeline(shard=...)`` driven by ``mesh.data_partition``),
+- the global batch is assembled shard-by-shard with
+  ``jax.make_array_from_process_local_data`` (``mesh.shard_batch``) — no
+  host ever materializes the global batch.
+
+The CLI maps the legacy ``--ps_hosts/--worker_hosts/--job_name/
+--task_index`` flags onto this (cli.py); ps tasks exit with a notice.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator: str, num_processes: int, process_id: int
+) -> None:
+    """Join the multi-host jax cluster (must run before any backend use)."""
+    import jax
+
+    log.info(
+        "initializing jax.distributed: coordinator=%s (%d processes, "
+        "this is %d)", coordinator, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -298,6 +298,32 @@ class Trainer:
     def _put(self, batch: Batch) -> Batch:
         return mesh_lib.shard_batch(batch, self.mesh)
 
+    def _input_plan(self):
+        """(pipeline_cfg, shard, ordered) for host-sharded input.
+
+        Multi-process: each host parses only its strided share of the
+        global stream at LOCAL batch size (global / num_blocks); the global
+        batch is assembled shard-by-shard in mesh_lib.shard_batch.  Hosts
+        that share a data block (model-axis-spanning processes) must
+        produce bit-identical batches in identical order, so their
+        pipelines run single-threaded (ordered)."""
+        import dataclasses
+
+        n_procs = jax.process_count()
+        if n_procs == 1:
+            return self.cfg, (0, 1), False
+        shard = mesh_lib.data_partition(self.mesh)
+        num_blocks = shard[1]
+        if self.cfg.batch_size % num_blocks:
+            raise ValueError(
+                f"batch_size {self.cfg.batch_size} not divisible by "
+                f"{num_blocks} host data blocks"
+            )
+        pipe_cfg = dataclasses.replace(
+            self.cfg, batch_size=self.cfg.batch_size // num_blocks
+        )
+        return pipe_cfg, shard, n_procs > num_blocks
+
     def reset_metrics(self):
         rep = NamedSharding(self.mesh, P())
         self.state = self.state._replace(
@@ -332,10 +358,10 @@ class Trainer:
         metrics_out = (
             open(cfg.metrics_file, "a") if cfg.metrics_file else None
         )
+        pipe_cfg, shard, ordered = self._input_plan()
         profiling = False
         t0 = time.time()
         last_log_t, last_log_ex = t0, 0.0
-        seen = 0.0
         stepno = 0
         try:
             for epoch in range(resume_epoch, cfg.epoch_num):
@@ -343,12 +369,14 @@ class Trainer:
                 self._batches_done = resume_skip if epoch == resume_epoch else 0
                 pipeline = BatchPipeline(
                     cfg.train_files,
-                    cfg,
+                    pipe_cfg,
                     weight_files=cfg.weight_files or None,
                     epochs=1,
                     shuffle=True,
                     seed=cfg.seed + epoch,
                     skip_batches=self._batches_done,
+                    shard=shard,
+                    ordered=ordered,
                 )
                 for batch in pipeline:
                     if cfg.profile_dir and stepno == cfg.profile_start_step:
@@ -364,20 +392,25 @@ class Trainer:
                         jax.profiler.stop_trace()
                         profiling = False
                         log.info("profiler trace written to %s", cfg.profile_dir)
-                    seen += float(np.sum(batch.weights > 0))
                     if cfg.log_steps and stepno % cfg.log_steps == 0:
+                        # Examples come from the on-device weight sum — the
+                        # GLOBAL count in multi-host runs (each host only
+                        # sees its local shard).
                         m = _finalize_metrics(self.state.metrics, cfg.loss_type)
                         now = time.time()
-                        rate = (seen - last_log_ex) / max(now - last_log_t, 1e-9)
-                        last_log_t, last_log_ex = now, seen
+                        rate = (m["examples"] - last_log_ex) / max(
+                            now - last_log_t, 1e-9
+                        )
+                        last_log_t, last_log_ex = now, m["examples"]
                         log.info(
                             "step %d examples %d loss %.6f auc %.4f ex/s %.0f",
-                            stepno, int(seen), m["loss"], m["auc"], rate,
+                            stepno, int(m["examples"]), m["loss"], m["auc"],
+                            rate,
                         )
                         if metrics_out is not None:
                             metrics_out.write(json.dumps({
                                 "step": stepno,
-                                "examples": seen,
+                                "examples": m["examples"],
                                 "loss": m["loss"],
                                 "auc": m["auc"],
                                 "examples_per_sec": rate,
@@ -412,7 +445,9 @@ class Trainer:
             if metrics_out is not None:
                 metrics_out.close()
         train_metrics = _finalize_metrics(self.state.metrics, cfg.loss_type)
-        train_metrics["examples_per_sec"] = seen / max(time.time() - t0, 1e-9)
+        train_metrics["examples_per_sec"] = (
+            train_metrics["examples"] / max(time.time() - t0, 1e-9)
+        )
         train_metrics["steps"] = stepno
         self.save(stepno)
         result = {"train": train_metrics}
@@ -428,7 +463,11 @@ class Trainer:
     def evaluate(self, files) -> dict:
         rep = NamedSharding(self.mesh, P())
         ms = jax.device_put(MetricState.zeros(), rep)
-        pipeline = BatchPipeline(files, self.cfg, epochs=1, shuffle=False)
+        pipe_cfg, shard, ordered = self._input_plan()
+        pipeline = BatchPipeline(
+            files, pipe_cfg, epochs=1, shuffle=False, shard=shard,
+            ordered=ordered,
+        )
         for batch in pipeline:
             ms = self._eval_step(self.state.params, ms, self._put(batch))
         return _finalize_metrics(ms, self.cfg.loss_type)
@@ -454,6 +493,12 @@ def predict(cfg: FmConfig, mesh=None) -> int:
     """
     if not cfg.predict_files:
         raise ValueError("no predict_files configured")
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "predict runs single-process (the reference scored on one "
+            "worker too); run it without jax.distributed — the sharded "
+            "checkpoint restores fine on fewer devices"
+        )
     mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
     param_sh = mesh_lib.param_sharding(mesh)
     template = _params_template(cfg, param_sh)

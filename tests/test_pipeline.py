@@ -195,3 +195,52 @@ def test_pipeline_drop_remainder(data_files):
     batches = list(pipe)
     assert all(int(np.sum(b.weights > 0)) == 4 for b in batches)
     assert len(batches) == 3  # 15 // 4
+
+
+def _keys(pipe):
+    return [
+        (b.labels.tobytes(), b.ids.tobytes(), b.vals.tobytes())
+        for b in pipe
+    ]
+
+
+def test_pipeline_shard_disjoint_and_complete(tmp_path):
+    """Host-sharded input: shards partition the identically-seeded stream
+    batch-for-batch (shard s takes items s, n+s, 2n+s, ...)."""
+    path = tmp_path / "data.libsvm"
+    path.write_text("".join(f"{i % 2} {i % 90}:1.0\n" for i in range(40)))
+    cfg = _cfg(thread_num=1)  # deterministic batch order
+    full = _keys(BatchPipeline([str(path)], cfg, epochs=1, shuffle=True))
+    assert len(full) == 10
+    s0 = _keys(BatchPipeline([str(path)], cfg, epochs=1, shuffle=True,
+                             shard=(0, 2)))
+    s1 = _keys(BatchPipeline([str(path)], cfg, epochs=1, shuffle=True,
+                             shard=(1, 2)))
+    assert s0 == full[0::2]
+    assert s1 == full[1::2]
+
+
+def test_pipeline_shard_drops_partial_round(tmp_path):
+    """Every shard must emit the SAME batch count (a host with one extra
+    step would deadlock the others), so the tail round is dropped when the
+    stream length is not a multiple of num_shards."""
+    path = tmp_path / "data.libsvm"
+    path.write_text("".join(f"1 {i % 90}:1.0\n" for i in range(20)))
+    cfg = _cfg(thread_num=1)  # 5 groups (last one partial)
+    s0 = _keys(BatchPipeline([str(path)], cfg, epochs=1, shuffle=False,
+                             ordered=True, shard=(0, 2)))
+    s1 = _keys(BatchPipeline([str(path)], cfg, epochs=1, shuffle=False,
+                             ordered=True, shard=(1, 2)))
+    assert len(s0) == len(s1) == 2  # floor(5 / 2) rounds
+
+
+def test_pipeline_shard_with_skip(tmp_path):
+    """Mid-epoch resume composes with sharding: skip applies to MY share."""
+    path = tmp_path / "data.libsvm"
+    path.write_text("".join(f"1 {i % 90}:1.0\n" for i in range(40)))
+    cfg = _cfg(thread_num=1)
+    s0 = _keys(BatchPipeline([str(path)], cfg, epochs=1, shuffle=True,
+                             shard=(0, 2)))
+    s0_skip = _keys(BatchPipeline([str(path)], cfg, epochs=1, shuffle=True,
+                                  shard=(0, 2), skip_batches=2))
+    assert s0_skip == s0[2:]
