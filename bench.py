@@ -144,6 +144,41 @@ def _make_batch(rng, cfg, vocab: int):
     )
 
 
+# Degradation ladder: config overrides tried in order until a trainer
+# survives a short smoke run.  A kernel that fails Mosaic compilation (the
+# round-3 bench died at the first step with an unlowerable scatter-add and
+# recorded 0.0 ex/s) must never zero a hardware window again — the XLA
+# scatter path and the jnp-oracle path are always available fallbacks.
+RUNGS = (
+    ("default", {}),
+    ("scatter", {"sparse_apply": "scatter"}),
+    ("no_pallas", {"sparse_apply": "scatter", "use_pallas": False}),
+)
+
+
+def build_trainer_with_ladder(make_cfg, trainer_cls, smoke_steps=2):
+    """Try each rung: build a trainer, run ``smoke_steps`` steps, drain.
+
+    Returns ``(rung_name, trainer, cfg, errors)`` where ``errors`` lists
+    ``"<rung>: <error>"`` for every rung that failed; ``rung_name`` is
+    None when all rungs failed (errors then explains each).
+    """
+    errors: list[str] = []
+    rng = np.random.default_rng(1)
+    for name, overrides in RUNGS:
+        try:
+            cfg = make_cfg(**overrides)
+            trainer = trainer_cls(cfg)
+            b = trainer._put(_make_batch(rng, cfg, cfg.vocabulary_size))
+            for _ in range(smoke_steps):
+                trainer.state = trainer._train_step(trainer.state, b)
+            _drain(trainer.state)
+            return name, trainer, cfg, errors
+        except Exception as e:  # noqa: BLE001 — the ladder must not die
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+    return None, None, None, errors
+
+
 def _bench_step_only(trainer, cfg, steps: int) -> float:
     rng = np.random.default_rng(0)
     batches = [trainer._put(_make_batch(rng, cfg, cfg.vocabulary_size))
@@ -225,26 +260,38 @@ def main() -> int:
     step_rate, e2e_rate, parse_rate = 0.0, 0.0, 0.0
     e2e_err = None
     cfg = None
+    ladder_rung, ladder_errors = None, []
     try:
         from fast_tffm_tpu.config import FmConfig
         from fast_tffm_tpu.train.loop import Trainer
 
-        cfg = FmConfig(
-            vocabulary_size=1 << 22 if on_tpu else 1 << 20,
-            factor_num=8,
-            max_features=39,
-            batch_size=(16384 if on_tpu else 4096) * max(1, n_chips),
-            learning_rate=0.05,
-            model_file="/tmp/fast_tffm_tpu_bench_model",
-            log_steps=0,
-            thread_num=min(16, max(4, (os.cpu_count() or 4) - 2)),
-            # Small queues: with deep queues the parser threads can finish
-            # the whole (finite) dataset during warmup and the "e2e" timed
-            # region would measure dequeue-only throughput, not ingest.
-            queue_size=2,
+        def make_cfg(**overrides):
+            c = FmConfig(
+                vocabulary_size=1 << 22 if on_tpu else 1 << 20,
+                factor_num=8,
+                max_features=39,
+                batch_size=(16384 if on_tpu else 4096) * max(1, n_chips),
+                learning_rate=0.05,
+                model_file="/tmp/fast_tffm_tpu_bench_model",
+                log_steps=0,
+                thread_num=min(16, max(4, (os.cpu_count() or 4) - 2)),
+                # Small queues: with deep queues the parser threads can
+                # finish the whole (finite) dataset during warmup and the
+                # "e2e" timed region would measure dequeue-only
+                # throughput, not ingest.
+                queue_size=2,
+                **overrides,
+            )
+            shutil.rmtree(c.model_file, ignore_errors=True)
+            return c
+
+        ladder_rung, trainer, cfg, ladder_errors = build_trainer_with_ladder(
+            make_cfg, Trainer
         )
-        shutil.rmtree(cfg.model_file, ignore_errors=True)
-        trainer = Trainer(cfg)
+        if trainer is None:
+            raise RuntimeError(
+                "all ladder rungs failed: " + " | ".join(ladder_errors)
+            )
 
         steps = args.steps if on_tpu else min(args.steps, 10)
         step_rate = _bench_step_only(trainer, cfg, steps)
@@ -308,6 +355,10 @@ def main() -> int:
         "platform": platform,
         "n_chips": n_chips,
     }
+    if ladder_rung is not None:
+        result["ladder_rung"] = ladder_rung
+    if ladder_errors:
+        result["ladder_errors"] = ladder_errors
     notes = [n for n in (err, e2e_err) if n]
     if notes:
         result["error"] = "; ".join(notes)

@@ -112,23 +112,35 @@ def _k1_kernel(starts_ref, firsts_ref, ends_ref, payload_ref, upos_ref,
         jax.lax.dot(oh, p_hi, preferred_element_type=jnp.float32)
         + jax.lax.dot(oh, p_lo, preferred_element_type=jnp.float32)
     )  # [C, L]
-    # Segment spanning in from the previous chunk: add its partial sums.
+    # Segment spanning in from the previous chunk: add its partial sums to
+    # row 0 via an iota mask — `.at[0:1].add` would emit a scatter-add HLO,
+    # which Mosaic has no TPU lowering for (it aborted the round-3 bench).
     continues = (firsts_ref[j] == 0) & (j > 0)
-    u_local = u_local.at[0:1, :].add(
-        jnp.where(continues, carry_ref[0:1, :], 0.0)
+    row0 = jax.lax.broadcasted_iota(jnp.int32, (chunk, lanes), 0) == 0
+    u_local = u_local + jnp.where(
+        row0 & continues,
+        jnp.broadcast_to(carry_ref[0:1, :], (chunk, lanes)),
+        0.0,
     )
     # Segment spanning out into the next chunk: move it to the carry and
     # write a zero — the chunk holding the segment's last occurrence is the
-    # last writer of that row and will hold the complete sum.
+    # last writer of that row and will hold the complete sum.  Row l_last is
+    # selected with an iota mask: value-level dynamic_slice /
+    # dynamic_update_slice have no Mosaic lowering either (same class as
+    # the scatter-add above).
     l_last = ends_ref[j] - upos_s
     cont_next = firsts_ref[j + 1] == 0
-    last_row = jax.lax.dynamic_slice(u_local, (l_last, 0), (1, lanes))
-    carry_ref[...] = jnp.where(cont_next, last_row, 0.0).repeat(8, 0)
-    u_local = jax.lax.dynamic_update_slice(
-        u_local,
-        jnp.where(cont_next, jnp.zeros((1, lanes), jnp.float32), last_row),
-        (l_last, 0),
+    r_iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, lanes), 0)
+    is_last = r_iota == l_last
+    last_row = jnp.sum(
+        jnp.where(is_last, u_local, 0.0), axis=0, keepdims=True
+    )  # [1, lanes] == u_local[l_last]
+    carry_ref[...] = jnp.broadcast_to(
+        jnp.where(cont_next, last_row, 0.0), (8, lanes)
     )
+    # If the segment continues, zero its row here; otherwise leave it (the
+    # reference code wrote last_row back to its own row — a no-op).
+    u_local = jnp.where(is_last & cont_next, 0.0, u_local)
     u_vmem[...] = u_local
     cp = pltpu.make_async_copy(u_vmem, out_ref.at[pl.ds(upos_s, chunk)], sem)
     cp.start()
@@ -170,8 +182,11 @@ def _placed_sums(u_vmem, cnt, d, tile):
     # The window tail belongs to later tiles (or is uninitialized); zero it
     # with where() — a multiply would keep NaN garbage (NaN*0 == NaN).
     u = jnp.where(mask, u_vmem[...], 0.0)  # [R, L]
-    lrow = u[:, 2 * d:2 * d + 1]  # [R, 1] f32 tile-local row, exact < R
-    r_iota = jax.lax.broadcasted_iota(jnp.float32, (tile, tile), 1)
+    # Tile-local row as int32 for the iota compare: tpu.iota is
+    # integer-only (a f32 iota fails Mosaic verification).  The f32 value
+    # is exact (< R <= 256), so the cast is too.
+    lrow = u[:, 2 * d:2 * d + 1].astype(jnp.int32)  # [R, 1] tile-local row
+    r_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
     p = ((lrow == r_iota) & mask).astype(jnp.bfloat16)  # [entry, row]
     u_hi = u.astype(jnp.bfloat16)
     u_lo = (u - u_hi.astype(jnp.float32)).astype(jnp.bfloat16)

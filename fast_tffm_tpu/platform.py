@@ -11,6 +11,7 @@ first jax backend touch.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import re
@@ -40,11 +41,36 @@ def is_tpu_backend() -> bool:
         return False
 
 
+_force_compiled = False
+
+
 def use_interpret() -> bool:
     """Pallas kernels run in interpret mode off-TPU (correctness tool; far
     slower than compiled Mosaic).  The ONE gate all kernel call sites
     share."""
+    if _force_compiled:
+        return False
     return not is_tpu_backend()
+
+
+@contextlib.contextmanager
+def force_compiled():
+    """Trace Pallas calls as compiled (Mosaic) even off-TPU.
+
+    Exists for cross-platform LOWERING tests: Mosaic's jaxpr->MLIR pass
+    runs at jax lowering time, so ``jax.export(..., platforms=['tpu'])``
+    under this context surfaces "Unimplemented primitive in Pallas TPU
+    lowering" errors on a CPU-only machine — the exact failure class that
+    interpret-mode tests structurally cannot catch (it zeroed the round-3
+    hardware bench).  Never use it to *execute* kernels off-TPU.
+    """
+    global _force_compiled
+    prev = _force_compiled
+    _force_compiled = True
+    try:
+        yield
+    finally:
+        _force_compiled = prev
 
 
 def pin_cpu(n_devices: int | None = None) -> None:

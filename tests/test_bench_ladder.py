@@ -1,0 +1,129 @@
+"""The bench degradation ladder must survive kernel failures.
+
+Round 3's hardware window produced 0.0 ex/s because the (then-broken)
+Pallas tile path crashed the first step and bench.py had no fallback.
+These tests inject failures at each rung and assert the ladder walks down
+to a working configuration, recording what failed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import bench
+from fast_tffm_tpu.config import FmConfig
+
+
+class _State:
+    """Just enough state surface for bench._drain."""
+
+    def __init__(self):
+        class P:
+            table = np.zeros((2, 2), np.float32)
+
+        class M:
+            loss_sum = np.float32(0)
+
+        self.params = P()
+        self.metrics = M()
+        self.step = np.int32(0)
+
+
+def _make_cfg(**overrides):
+    return FmConfig(
+        vocabulary_size=1024, factor_num=4, max_features=8, batch_size=64,
+        **overrides,
+    )
+
+
+class _FakeTrainer:
+    """Raises in _train_step unless the cfg matches ``works_when``."""
+
+    works_when: dict = {}
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.state = _State()
+
+    def _put(self, batch):
+        return batch
+
+    def _train_step(self, state, batch):
+        for key, val in type(self).works_when.items():
+            if getattr(self.cfg, key) != val:
+                raise NotImplementedError(
+                    f"injected Mosaic failure ({key}={getattr(self.cfg, key)})"
+                )
+        return state
+
+
+def test_ladder_walks_to_scatter():
+    class T(_FakeTrainer):
+        works_when = {"sparse_apply": "scatter"}
+
+    rung, trainer, cfg, errors = bench.build_trainer_with_ladder(_make_cfg, T)
+    assert rung == "scatter"
+    assert cfg.sparse_apply == "scatter"
+    assert len(errors) == 1 and "default" in errors[0]
+    assert "injected Mosaic failure" in errors[0]
+
+
+def test_ladder_walks_to_no_pallas():
+    class T(_FakeTrainer):
+        works_when = {"sparse_apply": "scatter", "use_pallas": False}
+
+    rung, trainer, cfg, errors = bench.build_trainer_with_ladder(_make_cfg, T)
+    assert rung == "no_pallas"
+    assert not cfg.use_pallas
+    assert len(errors) == 2
+
+
+def test_ladder_default_passes_first():
+    rung, trainer, cfg, errors = bench.build_trainer_with_ladder(
+        _make_cfg, _FakeTrainer
+    )
+    assert rung == "default"
+    assert errors == []
+
+
+def test_ladder_total_failure_reports_all():
+    class T(_FakeTrainer):
+        works_when = {"sparse_apply": "never-matches"}
+
+    rung, trainer, cfg, errors = bench.build_trainer_with_ladder(_make_cfg, T)
+    assert rung is None and trainer is None
+    assert len(errors) == 3
+
+
+def test_ladder_real_trainer_injected_step_failure(tmp_path):
+    """Integration: a real Trainer whose tile path is sabotaged falls back
+    to scatter and still trains."""
+    from fast_tffm_tpu.train.loop import Trainer
+
+    class SabotagedTrainer(Trainer):
+        def __init__(self, cfg):
+            super().__init__(cfg)
+            if cfg.sparse_apply != "scatter":
+                inner = self._train_step
+
+                def boom(state, batch):
+                    raise NotImplementedError(
+                        "Unimplemented primitive in Pallas TPU lowering"
+                    )
+
+                self._train_step = boom
+
+    def make_cfg(**overrides):
+        overrides.setdefault("sparse_apply", "tile")
+        return FmConfig(
+            vocabulary_size=1024, factor_num=4, max_features=8,
+            batch_size=64, model_file=str(tmp_path / "m"), **overrides,
+        )
+
+    rung, trainer, cfg, errors = bench.build_trainer_with_ladder(
+        make_cfg, SabotagedTrainer
+    )
+    assert rung == "scatter"
+    assert trainer is not None
+    assert any("Pallas TPU lowering" in e for e in errors)

@@ -1,0 +1,153 @@
+"""Cross-platform TPU *lowering* tests for every Pallas kernel path.
+
+Interpret-mode tests check kernel semantics but structurally cannot catch
+Mosaic lowering errors — "Unimplemented primitive in Pallas TPU lowering"
+aborted the round-3 hardware bench (scatter-add at the old
+sparse_apply K1 carry add) while every interpret test passed.  Mosaic's
+jaxpr->MLIR pass runs at jax LOWERING time, so ``jax.export`` with
+``platforms=['tpu']`` under ``platform.force_compiled()`` surfaces that
+entire failure class on this CPU-only machine.
+
+Every Pallas entry point must have a case here; a new kernel without one
+is unprotected against exactly the bug class that zeroed BENCH_r03.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fast_tffm_tpu import platform as pf
+from fast_tffm_tpu.ops import fm_pallas, sparse_apply
+
+V, D, N = 4096, 9, 2048
+B, F, K = 1024, 39, 8
+
+
+def lower_tpu(fn, *args):
+    """Export ``fn`` for the tpu platform; raises on Mosaic lowering errors."""
+    with pf.force_compiled():
+        return jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+def _s(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+class TestSparseApplyLowering:
+    def test_adagrad_apply(self):
+        lower_tpu(
+            functools.partial(sparse_apply.adagrad_apply, lr=0.1, eps=1e-7),
+            _s((V, D)), _s((V, D)), _s((N,), jnp.int32), _s((N, D)),
+        )
+
+    def test_sgd_apply(self):
+        lower_tpu(
+            functools.partial(sparse_apply.sgd_apply, lr=0.1),
+            _s((V, D)), _s((N,), jnp.int32), _s((N, D)),
+        )
+
+    def test_ftrl_apply(self):
+        lower_tpu(
+            functools.partial(
+                sparse_apply.ftrl_apply, lr=0.1, l1=0.01, l2=0.01, beta=1.0
+            ),
+            _s((V, D)), _s((V, D)), _s((V, D)), _s((N,), jnp.int32),
+            _s((N, D)),
+        )
+
+    def test_dense_delta(self):
+        lower_tpu(
+            functools.partial(
+                sparse_apply.dense_delta, vocab=V, vocab_local=V, row_lo=0
+            ),
+            _s((N,), jnp.int32), _s((N, D)),
+        )
+
+
+class TestFmKernelLowering:
+    def test_forward(self):
+        lower_tpu(
+            functools.partial(fm_pallas.fm_scores_pallas, interpret=False),
+            _s((B, F, 1 + K)), _s((B, F)),
+        )
+
+    def test_backward(self):
+        lower_tpu(
+            functools.partial(fm_pallas.fm_grad_pallas, interpret=False),
+            _s((B, F, 1 + K)), _s((B, F)), _s((B, K)), _s((B,)),
+        )
+
+
+class TestFullStepLowering:
+    """The exact step functions the trainer jits, lowered for TPU."""
+
+    @pytest.mark.parametrize("optimizer", ["adagrad", "ftrl", "sgd"])
+    def test_single_device_tile_step(self, optimizer):
+        from fast_tffm_tpu.config import FmConfig
+        from fast_tffm_tpu.data.libsvm import Batch
+        from fast_tffm_tpu.models import fm
+        from fast_tffm_tpu.train import sparse
+
+        cfg = FmConfig(
+            vocabulary_size=V, factor_num=K, max_features=F,
+            batch_size=B, optimizer=optimizer, sparse_apply="tile",
+            use_pallas=True,
+        )
+        params = fm.FmParams(w0=_s(()), table=_s((V, 1 + K)))
+        opt = sparse.init_sparse_opt_state(
+            cfg, fm.FmParams(w0=jnp.zeros(()), table=jnp.zeros((V, 1 + K)))
+        )
+        opt = jax.tree.map(lambda a: _s(a.shape, a.dtype), opt)
+        batch = Batch(
+            labels=_s((B,)), ids=_s((B, F), jnp.int32), vals=_s((B, F)),
+            fields=_s((B, F), jnp.int32), weights=_s((B,)),
+        )
+
+        def step(params, opt, batch):
+            p, o, scores = sparse.sparse_step(cfg, params, opt, batch)
+            return p, o, scores
+
+        lower_tpu(step, params, opt, batch)
+
+    @pytest.mark.parametrize("optimizer", ["adagrad", "ftrl"])
+    def test_shardmap_step(self, optimizer):
+        """The hand-sharded multi-device step over the virtual 8-dev mesh."""
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from fast_tffm_tpu.config import FmConfig
+        from fast_tffm_tpu.data.libsvm import Batch
+        from fast_tffm_tpu.models import fm
+        from fast_tffm_tpu.parallel import mesh as mesh_lib
+        from fast_tffm_tpu.train import shardmap_step, sparse
+
+        mesh = Mesh(
+            np.array(jax.devices()[:8]).reshape(4, 2),
+            (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS),
+        )
+        cfg = FmConfig(
+            vocabulary_size=V, factor_num=K, max_features=F,
+            batch_size=B, optimizer=optimizer, sparse_apply="tile",
+            use_pallas=True,
+        )
+        assert shardmap_step.supports_shardmap(cfg, mesh)
+        params = fm.FmParams(w0=_s(()), table=_s((V, 1 + K)))
+        opt = sparse.init_sparse_opt_state(
+            cfg, fm.FmParams(w0=jnp.zeros(()), table=jnp.zeros((V, 1 + K)))
+        )
+        opt = jax.tree.map(lambda a: _s(a.shape, a.dtype), opt)
+        batch = Batch(
+            labels=_s((B,)), ids=_s((B, F), jnp.int32), vals=_s((B, F)),
+            fields=_s((B, F), jnp.int32), weights=_s((B,)),
+        )
+
+        def step(params, opt, batch):
+            return shardmap_step.sparse_step_shardmap(
+                cfg, params, opt, batch, mesh
+            )
+
+        lower_tpu(step, params, opt, batch)
