@@ -156,16 +156,25 @@ RUNGS = (
 )
 
 
-def build_trainer_with_ladder(make_cfg, trainer_cls, smoke_steps=2):
+def build_trainer_with_ladder(make_cfg, trainer_cls, smoke_steps=2,
+                              start_rung=None):
     """Try each rung: build a trainer, run ``smoke_steps`` steps, drain.
 
     Returns ``(rung_name, trainer, cfg, errors)`` where ``errors`` lists
     ``"<rung>: <error>"`` for every rung that failed; ``rung_name`` is
     None when all rungs failed (errors then explains each).
+
+    ``start_rung`` skips rungs before the named one — used to pin a
+    variant measurement (bf16) to the rung the main config selected, so
+    the two rates always compare the same kernel path.
     """
     errors: list[str] = []
     rng = np.random.default_rng(1)
-    for name, overrides in RUNGS:
+    rungs = RUNGS
+    if start_rung is not None:
+        idx = [i for i, (n, _) in enumerate(RUNGS) if n == start_rung]
+        rungs = RUNGS[idx[0]:] if idx else RUNGS
+    for name, overrides in rungs:
         try:
             cfg = make_cfg(**overrides)
             trainer = trainer_cls(cfg)
@@ -208,9 +217,9 @@ def _bench_parse_only(files, cfg) -> float:
         return 0.0
     n = 0
     t0 = time.perf_counter()
-    for buf, offsets in _iter_raw_groups(files, cfg.batch_size):
-        parser.parse_raw(buf, offsets, cfg.batch_size)
-        n += len(offsets) - 1
+    for buf, starts, ends in _iter_raw_groups(files, cfg.batch_size):
+        parser.parse_raw(buf, starts, ends, cfg.batch_size)
+        n += len(starts)
     dt = time.perf_counter() - t0
     return n / dt if dt > 0 else 0.0
 
@@ -257,7 +266,8 @@ def main() -> int:
         platform, n_chips = "cpu", len(jax.devices())
 
     on_tpu = platform not in ("cpu",)
-    step_rate, e2e_rate, parse_rate = 0.0, 0.0, 0.0
+    step_rate, e2e_rate, parse_rate, bf16_rate = 0.0, 0.0, 0.0, 0.0
+    bf16_rung, bf16_errors = None, []
     e2e_err = None
     cfg = None
     ladder_rung, ladder_errors = None, []
@@ -295,6 +305,24 @@ def main() -> int:
 
         steps = args.steps if on_tpu else min(args.steps, 10)
         step_rate = _bench_step_only(trainer, cfg, steps)
+
+        # bf16 compute variant (rounds the interaction operands, halving
+        # the gathered-rows HBM streams).  Pinned to start at the rung the
+        # f32 config selected so the two rates compare the same kernel
+        # path; its rung and any errors are recorded in the JSON.
+        try:
+            bf16_rung, t16, c16, bf16_errors = build_trainer_with_ladder(
+                lambda **kw: make_cfg(
+                    **{"compute_dtype": "bfloat16", **kw}
+                ),
+                Trainer,
+                start_rung=ladder_rung,
+            )
+            if t16 is not None:
+                bf16_rate = _bench_step_only(t16, c16, steps)
+                del t16
+        except Exception as e:  # noqa: BLE001 — bf16 must not sink the bench
+            bf16_errors = [f"bf16 bench: {type(e).__name__}: {e}"]
 
         if args.mode == "e2e":
             try:
@@ -350,6 +378,7 @@ def main() -> int:
         "unit": "examples/sec",
         "vs_baseline": round(per_chip / PER_CHIP_TARGET, 4),
         "step_only_examples_per_sec": round(step_rate, 1),
+        "step_only_bf16_examples_per_sec": round(bf16_rate, 1),
         "e2e_examples_per_sec": round(e2e_rate, 1),
         "parse_lines_per_sec": round(parse_rate, 1),
         "platform": platform,
@@ -359,6 +388,10 @@ def main() -> int:
         result["ladder_rung"] = ladder_rung
     if ladder_errors:
         result["ladder_errors"] = ladder_errors
+    if bf16_rung is not None and bf16_rung != ladder_rung:
+        result["bf16_ladder_rung"] = bf16_rung
+    if bf16_errors:
+        result["bf16_ladder_errors"] = bf16_errors
     notes = [n for n in (err, e2e_err) if n]
     if notes:
         result["error"] = "; ".join(notes)

@@ -123,9 +123,10 @@ class FmConfig:
     # (ops.sparse_apply), "auto" picks tile when supported.
     sparse_apply: str = "auto"
     # Fast ingest: read files as raw binary chunks, C++ line scan + parse,
-    # no Python string per line. Shuffling then happens at batch-group
-    # granularity instead of line granularity. Line path is used for
-    # weight_files or when the native parser is unavailable.
+    # no Python string per line. Shuffling permutes lines within
+    # shuffle_buffer-line windows (same mixing window as the line path's
+    # reservoir). Line path is used for weight_files or when the native
+    # parser is unavailable.
     fast_ingest: bool = True
     # L2 mode: "batch" regularizes only the rows touched by the batch
     # (sparse-friendly); "full" regularizes the whole table (dense grads,
@@ -147,6 +148,8 @@ class FmConfig:
             raise ValueError(f"unknown l2_mode {self.l2_mode!r}")
         if self.sparse_apply not in ("auto", "tile", "scatter"):
             raise ValueError(f"unknown sparse_apply {self.sparse_apply!r}")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.weight_files and len(self.weight_files) != len(self.train_files):
             raise ValueError(
                 "weight_files must parallel train_files "
@@ -158,6 +161,15 @@ class FmConfig:
         """Width of one table row: 1 linear weight + factor vector(s)."""
         k = self.factor_num
         return 1 + (k * self.field_num if self.field_num else k)
+
+    @property
+    def compute_jnp_dtype(self):
+        """The interaction compute dtype as a jnp dtype.  bfloat16 halves
+        the gathered-rows HBM traffic (the sparse step's dominant cost);
+        parameters, optimizer state, loss and metrics stay float32."""
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
 
 
 # INI key -> (dataclass field, parser).  Keys match the reference cfg surface

@@ -9,7 +9,9 @@
 // offsets; lines are split evenly across worker threads, each writing its
 // own disjoint rows of the output arrays — no locks in the hot path.
 //
-// Build: g++ -O3 -march=native -shared -fPIC -pthread fm_parser.cc -o libfm_parser.so
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread fm_parser.cc -o libfm_parser.so
+// (plain -O3, no -march=native: measured faster here, and the cached .so
+// stays portable across CPUs — see data/native.py)
 
 #include <cstdint>
 #include <cstring>
@@ -100,10 +102,15 @@ inline bool ParseInt(const char* s, const char* e, int64_t* out) {
   return true;
 }
 
-// Parses a decimal feature id of ANY length and reduces it mod m on the
-// fly, matching Python's arbitrary-precision int(token) % m exactly
+// Parses a decimal feature id of ANY length and reduces it mod m,
+// matching Python's arbitrary-precision int(token) % m exactly
 // (including the non-negative result for negative ids). Requires
 // m < 2^59 so r*10 + digit cannot overflow uint64.
+//
+// Fast path: ids with <= 19 significant digits (everything real data
+// contains) accumulate without reduction and take ONE final mod —
+// per-digit "% m" costs a 20-40 cycle divide per digit and dominated the
+// whole parse at ~7-digit Criteo ids.  Longer ids reduce per digit.
 inline bool ParseIdMod(const char* s, const char* e, uint64_t m,
                        int64_t* out) {
   if (s >= e) return false;
@@ -113,11 +120,22 @@ inline bool ParseIdMod(const char* s, const char* e, uint64_t m,
     ++s;
   }
   if (s >= e) return false;
+  // Skip leading zeros so only significant digits count toward the 19.
+  while (s < e && *s == '0') ++s;
   uint64_t r = 0;
-  for (; s < e; ++s) {
-    char c = *s;
-    if (c < '0' || c > '9') return false;
-    r = (r * 10 + static_cast<uint64_t>(c - '0')) % m;
+  if (e - s <= 19) {
+    for (; s < e; ++s) {
+      char c = *s;
+      if (c < '0' || c > '9') return false;
+      r = r * 10 + static_cast<uint64_t>(c - '0');
+    }
+    r %= m;  // 19 digits < 2^64: no overflow before the single mod
+  } else {
+    for (; s < e; ++s) {
+      char c = *s;
+      if (c < '0' || c > '9') return false;
+      r = (r * 10 + static_cast<uint64_t>(c - '0')) % m;
+    }
   }
   if (neg && r) r = m - r;
   *out = static_cast<int64_t>(r);
@@ -202,24 +220,23 @@ int ParseLine(const Parser& p, const char* s, const char* end, int64_t row,
   while (cur < end) {
     while (cur < end && IsSpace(*cur)) ++cur;
     if (cur >= end) break;
+    // One pass: find the token end and split on ':' as we go — up to 3
+    // pieces: [field:]id[:val].
     const char* tok = cur;
-    while (cur < end && !IsSpace(*cur)) ++cur;
-    const char* tok_end = cur;
-
-    // Split token on ':' — up to 3 pieces: [field:]id[:val]
     const char* c1 = nullptr;
     const char* c2 = nullptr;
-    for (const char* q = tok; q < tok_end; ++q) {
-      if (*q == ':') {
+    for (; cur < end && !IsSpace(*cur); ++cur) {
+      if (*cur == ':') {
         if (!c1) {
-          c1 = q;
+          c1 = cur;
         } else if (!c2) {
-          c2 = q;
+          c2 = cur;
         } else {
           return -1;  // too many colons
         }
       }
     }
+    const char* tok_end = cur;
     const char *id_s, *id_e;
     const char *val_s = nullptr, *val_e = nullptr;
     int64_t field = 0;
@@ -377,18 +394,21 @@ int64_t fm_parser_find_lines(const char* buf, int64_t len, int64_t* out,
   return count;
 }
 
-// Like fm_parser_parse but marks blank/comment lines with weight 0 (the
-// raw-chunk path has no Python-side blank filtering). Lines that parse get
-// weight weights_in[i] (or 1.0). Same return convention.
+// Like fm_parser_parse but takes per-line [start, end) extents — lines
+// need not be contiguous or ordered in buf (the pipeline's line-level
+// shuffle hands a permuted view of a window) — and marks blank/comment
+// lines with weight 0 (the raw-chunk path has no Python-side blank
+// filtering). Lines that parse get weight weights_in[i] (or 1.0). Same
+// return convention.
 int64_t fm_parser_parse_raw(void* handle, const char* buf,
-                            const int64_t* offsets, int64_t n_lines,
-                            float* labels, int32_t* ids, float* vals,
-                            int32_t* fields, float* weights,
+                            const int64_t* starts, const int64_t* ends,
+                            int64_t n_lines, float* labels, int32_t* ids,
+                            float* vals, int32_t* fields, float* weights,
                             const float* weights_in) {
   const Parser& p = *static_cast<Parser*>(handle);
   return RunLines(p, n_lines, [&](int64_t i, int64_t* local_dropped) {
-    const char* s = buf + offsets[i];
-    const char* e = buf + offsets[i + 1];
+    const char* s = buf + starts[i];
+    const char* e = buf + ends[i];
     // Blank/comment lines become weight-0 rows (the raw-chunk path has no
     // Python-side blank filtering); detection mirrors ParseLine's trim.
     const char* t = s;

@@ -33,6 +33,10 @@ def _build() -> str:
     with _build_lock:
         if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
             return _LIB
+        # Plain -O3, no -march=native: measured FASTER here (819k vs 705k
+        # lines/s — native's wider vectorization loses on this workload),
+        # and a baseline-ISA .so stays safe if the built artifact ever
+        # moves to a different CPU (the mtime cache can't tell).
         cmd = [
             "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
             _SRC, "-o", _LIB + ".tmp",
@@ -76,7 +80,19 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int64,
     ]
     lib.fm_parser_parse_raw.restype = ctypes.c_int64
-    lib.fm_parser_parse_raw.argtypes = lib.fm_parser_parse.argtypes
+    lib.fm_parser_parse_raw.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # starts
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # ends
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p,
+    ]
     _lib = lib
     return lib
 
@@ -118,6 +134,7 @@ class NativeParser:
         self._lib = _load()
         self.max_features = max_features
         self.truncated_features = 0  # running count, like reference warnings
+        self._trunc_lock = threading.Lock()  # parser threads share self
         self._handle = self._lib.fm_parser_create(
             vocabulary_size, max_features, int(hash_feature_id), field_num,
             num_threads,
@@ -175,37 +192,45 @@ class NativeParser:
                 f"malformed libsvm input at batch line {bad}: {lines[bad]!r}"
             )
         if dropped:
-            self.truncated_features += int(dropped)
+            with self._trunc_lock:
+                self.truncated_features += int(dropped)
         return Batch(labels, ids, vals, fields, w)
 
     def parse_raw(
         self,
         buf: bytes,
-        offsets: np.ndarray,  # [n+1] int64: line starts + end-of-last-line
+        starts: np.ndarray,  # [n] int64 line-start offsets into buf
+        ends: np.ndarray,  # [n] int64 line-end offsets (exclusive)
         batch_size: int,
     ) -> Batch:
         """Zero-copy fast path: parse lines straight out of a raw file
-        chunk (no Python string per line). Blank/comment lines become
+        chunk (no Python string per line).  Lines may be non-contiguous
+        and in any order — the pipeline's line-level shuffle passes a
+        permuted view of a scanned window.  Blank/comment lines become
         weight-0 rows."""
-        n = len(offsets) - 1
+        n = len(starts)
         if n > batch_size:
             raise ValueError(f"{n} lines > batch_size {batch_size}")
-        offsets = np.ascontiguousarray(offsets, np.int64)
+        if len(ends) != n:
+            raise ValueError(f"starts/ends length mismatch: {n}/{len(ends)}")
+        starts = np.ascontiguousarray(starts, np.int64)
+        ends = np.ascontiguousarray(ends, np.int64)
         labels = np.zeros((batch_size,), np.float32)
         ids = np.zeros((batch_size, self.max_features), np.int32)
         vals = np.zeros((batch_size, self.max_features), np.float32)
         fields = np.zeros((batch_size, self.max_features), np.int32)
         w = np.zeros((batch_size,), np.float32)
         dropped = self._lib.fm_parser_parse_raw(
-            self._handle, buf, offsets, n, labels, ids, vals, fields, w,
-            None,
+            self._handle, buf, starts, ends, n, labels, ids, vals, fields,
+            w, None,
         )
         if dropped < 0:
             bad = -int(dropped) - 1
-            text = buf[offsets[bad]:offsets[bad + 1]]
+            text = buf[starts[bad]:ends[bad]]
             raise ValueError(
                 f"malformed libsvm input at chunk line {bad}: {text!r}"
             )
         if dropped:
-            self.truncated_features += int(dropped)
+            with self._trunc_lock:
+                self.truncated_features += int(dropped)
         return Batch(labels, ids, vals, fields, w)

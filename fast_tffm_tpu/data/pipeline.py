@@ -27,8 +27,10 @@ from fast_tffm_tpu.data import libsvm
 
 log = logging.getLogger(__name__)
 
-# Raw-chunk read size for the fast ingest path. Each shuffled group keeps
-# its source chunk alive, so this also bounds shuffle-buffer memory.
+# Raw-chunk read size for the fast ingest path.  Groups reference their
+# window buffer (~shuffle_buffer lines when shuffling), so resident memory
+# is bounded by the in-flight group count (work queue + parser threads)
+# times the window byte size — a few windows in practice.
 _CHUNK_BYTES = 4 << 20
 
 _SENTINEL = object()
@@ -113,70 +115,99 @@ def _raw_chunk_stream(files: Sequence[str], chunk_bytes: int):
             yield b"\n"
 
 
-def _iter_raw_groups(
-    files: Sequence[str], batch_size: int, chunk_bytes: int = _CHUNK_BYTES
+def _iter_raw_windows(
+    files: Sequence[str],
+    batch_size: int,
+    window_lines: int,
+    chunk_bytes: int = _CHUNK_BYTES,
 ):
-    """Yield (buf, offsets[n+1]) groups of <= batch_size raw text lines.
+    """Yield (buf, starts[n], ends[n]) windows of complete raw text lines.
 
-    The fast ingest path: files are read in binary chunks, line starts
-    found by the C++ scanner, and groups reference the chunk buffer
-    directly — no Python string is ever created per line.  Chunks are
-    accumulated (newline counts are cheap) and joined ONCE per buffer so
-    oversized batches don't cause quadratic re-copies; leftover lines are
-    carried into the next buffer, including across file boundaries.
+    The fast ingest path: files are read in binary chunks, accumulated to
+    a byte target predicted from a running bytes-per-line estimate, and
+    scanned ONCE by the C++ line scanner — the previous design counted
+    newlines with bytes.count() first and then re-scanned with memchr,
+    paying two passes over every byte.  Windows reference the joined
+    buffer directly; no Python string is ever created per line.
+
+    Mid-stream windows hold a multiple of ``batch_size`` lines so the
+    caller can slice exact groups; leftover lines (plus any incomplete
+    tail) are carried into the next buffer as bytes, including across
+    file boundaries.  The final window flushes everything.
     """
     from fast_tffm_tpu.data import native
 
+    window_lines = max(window_lines, batch_size)
     stream = _raw_chunk_stream(files, chunk_bytes)
     pending = b""
+    est_bpl = 80.0  # running bytes-per-line estimate
+    guess = 0  # line-count guess for the scanner (stable density)
     at_eof = False
-    guess = 0  # line-count guess carried between buffers (stable density)
     while not at_eof:
+        target = int(window_lines * est_bpl) + 1
         parts = [pending]
-        nls = pending.count(b"\n")
-        # Gather at least one full group's worth of complete lines.
-        while nls < batch_size:
+        size = len(parts[0])
+        first = True
+        # Read at least one chunk per round (guarantees progress when the
+        # carried-over pending bytes alone held < one batch of lines).
+        while size < target or first:
+            first = False
             chunk = next(stream, None)
             if chunk is None:
                 at_eof = True
                 break
             parts.append(chunk)
-            nls += chunk.count(b"\n")
+            size += len(chunk)
         buf = b"".join(parts)
         pending = b""
-        if at_eof:
-            buf_end = len(buf)
-        else:
-            buf_end = buf.rfind(b"\n") + 1  # >=1: nls >= batch_size >= 1
+        if not buf:
+            continue  # at_eof: the while condition ends the loop
+        buf_end = len(buf) if at_eof else buf.rfind(b"\n") + 1
+        if buf_end == 0:  # not a single complete line yet; need more bytes
+            pending = buf
+            est_bpl *= 2.0
+            continue
         starts = native.find_line_offsets(buf, buf_end, guess=guess or None)
-        n_lines = len(starts)
-        guess = n_lines + 2
-        if n_lines == 0:
+        n = len(starts)
+        if n == 0:
             if at_eof:
                 return
             pending = buf
             continue
+        est_bpl = buf_end / n
+        guess = n + 2
         ends = np.append(starts[1:], buf_end)
         if at_eof:
-            n_keep = n_lines  # flush everything, partial group included
+            n_keep = n  # flush everything, partial group included
         else:
-            n_keep = (n_lines // batch_size) * batch_size
-            leftover_start = (
-                int(starts[n_keep]) if n_keep < n_lines else buf_end
-            )
-            pending = buf[leftover_start:]
-        for i in range(0, n_keep, batch_size):
-            j = min(i + batch_size, n_keep)
-            offsets = np.empty((j - i + 1,), np.int64)
-            offsets[:-1] = starts[i:j]
-            offsets[-1] = ends[j - 1]
-            yield (buf, offsets)
+            n_keep = (n // batch_size) * batch_size
+            if n_keep == 0:  # window bytes held < one batch of lines
+                pending = buf
+                continue
+            if n_keep < n:
+                pending = buf[int(starts[n_keep]):]
+            elif buf_end < len(buf):
+                pending = buf[buf_end:]
+        yield buf, starts[:n_keep], ends[:n_keep]
+
+
+def _iter_raw_groups(
+    files: Sequence[str], batch_size: int, chunk_bytes: int = _CHUNK_BYTES
+):
+    """Yield (buf, starts, ends) groups of <= batch_size raw lines, in
+    file order (no shuffle) — the unshuffled convenience used by bench
+    and tests; BatchPipeline slices windows itself to shuffle lines."""
+    for buf, starts, ends in _iter_raw_windows(
+        files, batch_size, batch_size, chunk_bytes
+    ):
+        for i in range(0, len(starts), batch_size):
+            yield buf, starts[i:i + batch_size], ends[i:i + batch_size]
 
 
 def _item_len(item) -> int:
     """Number of lines in a work item (line chunk or raw group)."""
     if isinstance(item, tuple):
-        return len(item[1]) - 1
+        return len(item[1])
     return len(item)
 
 
@@ -207,12 +238,12 @@ def _strided_rounds(it, shard_id: int, num_shards: int):
 class BatchPipeline:
     """Background-threaded parse/batch pipeline.
 
-    One reader thread streams (line, weight) pairs into a work queue in
-    chunks; ``thread_num`` parser threads turn chunks into padded
-    :class:`Batch` objects pushed to a bounded output queue
-    (``queue_size``).  Batch order is nondeterministic across parser
-    threads (like the reference's async queues); set ``thread_num=1`` for
-    determinism.
+    One reader thread streams work items into a queue; ``thread_num``
+    parser threads turn them into padded :class:`Batch` objects pushed to
+    a bounded output queue (``queue_size``).  Batch order is
+    nondeterministic across parser threads (like the reference's async
+    queues) unless ``ordered=True``, which keeps the parallel parse but
+    reorders delivery by sequence number (deterministic given the seed).
     """
 
     def __init__(
@@ -248,24 +279,34 @@ class BatchPipeline:
         if not (0 <= shard[0] < shard[1]):
             raise ValueError(f"bad shard {shard}")
         self.shard = shard
-        # ordered=True forces one parser thread so batches come out in
-        # input order (the predict path needs score/line alignment).
+        # ordered=True delivers batches in input order (the predict path
+        # needs score/line alignment; model-axis-spanning hosts need
+        # identical order).  Parsing still runs on thread_num workers —
+        # items carry sequence numbers and the consumer reorders.
         self.ordered = ordered
         self._native, self._parser = _make_parser(cfg)
         # Fast ingest: raw binary chunks + C++ line scan, no Python string
         # per line. Requires the native parser; weight_files need per-line
-        # pairing so they stay on the line path. Shuffling happens at
-        # batch-group granularity here (the line path shuffles lines).
+        # pairing so they stay on the line path. Shuffling permutes LINES
+        # within shuffle_buffer-line windows (matching the line path's
+        # reservoir window).
         self._raw = (
             cfg.fast_ingest and self._native is not None
             and not self.weight_files
         )
 
+    @property
+    def truncated_features(self) -> int:
+        """Feature occurrences dropped by max_features so far (reference
+        FmParser warned about truncation, SURVEY.md §2 #1); the trainer
+        surfaces this periodically."""
+        return self._native.truncated_features if self._native else 0
+
     def __iter__(self) -> Iterator[libsvm.Batch]:
         cfg = self.cfg
         work: queue.Queue = queue.Queue(maxsize=max(2, cfg.queue_size))
         out: queue.Queue = queue.Queue(maxsize=max(2, cfg.queue_size))
-        n_workers = 1 if self.ordered else max(1, cfg.thread_num)
+        n_workers = max(1, cfg.thread_num)
         stop = threading.Event()
 
         def put_checked(q: queue.Queue, item) -> bool:
@@ -292,18 +333,43 @@ class BatchPipeline:
             if chunk:
                 yield chunk
 
+        def _raw_groups(rng):
+            """Fast path: scan-once windows -> line-level shuffle ->
+            groups.  The shuffle window is ``shuffle_buffer`` LINES (like
+            the line path's reservoir), permuted with numpy — each group
+            then references a shuffled, non-contiguous view of the window
+            buffer, which parse_raw gathers zero-copy."""
+            window = (
+                max(cfg.shuffle_buffer, cfg.batch_size)
+                if self.shuffle else cfg.batch_size
+            )
+            for buf, starts, ends in _iter_raw_windows(
+                self.files, cfg.batch_size, window
+            ):
+                n = len(starts)
+                if self.shuffle and n > 1:
+                    perm = np.random.default_rng(
+                        rng.getrandbits(63)
+                    ).permutation(n)
+                    starts, ends = starts[perm], ends[perm]
+                for i in range(0, n, cfg.batch_size):
+                    yield buf, starts[i:i + cfg.batch_size], ends[
+                        i:i + cfg.batch_size
+                    ]
+
         def reader():
             try:
+                seq = 0
                 for epoch in range(self.epochs):
                     rng = random.Random(self.seed + epoch)
                     to_skip = self.skip_batches if epoch == 0 else 0
                     if self._raw:
-                        it = _iter_raw_groups(self.files, cfg.batch_size)
-                        if self.shuffle:  # group-granularity shuffle
-                            buffer = max(
-                                1, cfg.shuffle_buffer // cfg.batch_size
-                            )
-                            it = _shuffled(it, buffer, rng)
+                        # Line-level shuffle happens inside _raw_groups
+                        # over shuffle_buffer-line windows — the same
+                        # mixing window as the line path's reservoir, so
+                        # no group-order reservoir on top (stacking one
+                        # would pin many window buffers at once).
+                        it = _raw_groups(rng)
                     else:
                         it = _line_chunks(rng)
                     if self.drop_remainder:
@@ -322,8 +388,9 @@ class BatchPipeline:
                         if to_skip > 0:
                             to_skip -= 1
                             continue
-                        if not put_checked(work, item):
+                        if not put_checked(work, (seq, item)):
                             return
+                        seq += 1
             except BaseException as e:  # surfaces in the consumer
                 put_checked(out, _Error(e))
             finally:
@@ -333,16 +400,17 @@ class BatchPipeline:
         def parse_worker():
             while not stop.is_set():
                 try:
-                    chunk = work.get(timeout=0.1)
+                    got = work.get(timeout=0.1)
                 except queue.Empty:
                     continue
-                if chunk is _SENTINEL:
+                if got is _SENTINEL:
                     put_checked(out, _SENTINEL)
                     return
+                seq, chunk = got
                 try:
-                    if isinstance(chunk, tuple):  # raw (buf, offsets) group
+                    if isinstance(chunk, tuple):  # raw (buf, starts, ends)
                         batch = self._native.parse_raw(
-                            chunk[0], chunk[1], cfg.batch_size
+                            chunk[0], chunk[1], chunk[2], cfg.batch_size
                         )
                     else:
                         lines = [c[0] for c in chunk]
@@ -351,7 +419,7 @@ class BatchPipeline:
                 except BaseException as e:
                     put_checked(out, _Error(e))
                     continue
-                put_checked(out, batch)
+                put_checked(out, (seq, batch))
 
         threads = [threading.Thread(target=reader, daemon=True)]
         threads += [
@@ -361,6 +429,8 @@ class BatchPipeline:
         for t in threads:
             t.start()
         finished = 0
+        next_seq = 0
+        held: dict = {}  # ordered mode: out-of-order batches by seq
         try:
             while finished < n_workers:
                 item = out.get()
@@ -369,7 +439,21 @@ class BatchPipeline:
                     continue
                 if isinstance(item, _Error):
                     raise item.exc
-                yield item
+                seq, batch = item
+                if not self.ordered:
+                    yield batch
+                    continue
+                # Reorder by sequence number: parsing is parallel but
+                # delivery follows reader order (bounded by in-flight
+                # items: work queue + workers + out queue).
+                held[seq] = batch
+                while next_seq in held:
+                    yield held.pop(next_seq)
+                    next_seq += 1
+            # Workers exited; whatever is held is contiguous from
+            # next_seq (an error would have raised above).
+            for seq in sorted(held):
+                yield held[seq]
         finally:
             # Unblock and reap every thread: stop flag + drain both queues.
             stop.set()

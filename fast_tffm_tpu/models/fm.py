@@ -58,10 +58,12 @@ def interaction_terms(
     vals = vals.astype(compute_dtype)
     w = rows[..., 0]  # [B, F]
     v = rows[..., 1:]  # [B, F, k]
-    linear = jnp.sum(w * vals, axis=-1)  # [B]
+    # bf16 mode rounds the products; sums still accumulate in f32 (the
+    # s1^2 - s2 cancellation in scores_from_terms amplifies sum error).
+    linear = jnp.sum(w * vals, axis=-1, dtype=jnp.float32)  # [B]
     xv = v * vals[..., None]  # [B, F, k]
-    s1 = jnp.sum(xv, axis=1)  # [B, k]
-    s2 = jnp.sum(xv * xv, axis=1)  # [B, k]
+    s1 = jnp.sum(xv, axis=1, dtype=jnp.float32)  # [B, k]
+    s2 = jnp.sum(xv * xv, axis=1, dtype=jnp.float32)  # [B, k]
     return linear, s1, s2
 
 
@@ -95,18 +97,26 @@ def ffm_scores_from_rows(
     b, f = vals.shape
     w = rows[..., 0]
     v = rows[..., 1:].reshape(b, f, field_num, factor_num)  # [B,F,P,k]
-    linear = jnp.sum(w * vals, axis=-1)
+    # bf16 mode: bf16 operands, f32 accumulation/result throughout.
+    linear = jnp.sum(w * vals, axis=-1, dtype=jnp.float32)
     oh = (
         fields[..., None] == jnp.arange(field_num, dtype=fields.dtype)
     ).astype(compute_dtype)  # [B, F, P] pure field one-hot
-    s = jnp.einsum("bfp,bfqk->bpqk", oh * vals[..., None], v)
-    cross = jnp.einsum("bpqk,bqpk->b", s, s)
-    v_own = jnp.einsum("bfq,bfqk->bfk", oh, v)  # v_i^{f_i}
+    s = jnp.einsum(
+        "bfp,bfqk->bpqk", oh * vals[..., None], v,
+        preferred_element_type=jnp.float32,
+    )
+    cross = jnp.einsum("bpqk,bqpk->b", s, s)  # s is f32
+    v_own = jnp.einsum(
+        "bfq,bfqk->bfk", oh, v, preferred_element_type=jnp.float32
+    )  # v_i^{f_i}
     self_term = jnp.sum(
-        jnp.sum(v_own * v_own, axis=-1) * vals * vals, axis=-1
+        jnp.sum(v_own * v_own, axis=-1)
+        * (vals * vals).astype(jnp.float32),
+        axis=-1,
     )
     inter = 0.5 * (cross - self_term)
-    return w0 + linear + inter
+    return (w0 + linear + inter).astype(jnp.float32)
 
 
 def fm_scores(
@@ -192,7 +202,9 @@ def loss_and_metrics(
     else:
         linear, s1, s2 = interaction_terms(rows, vals, compute_dtype)
         scores = scores_from_terms(params.w0.astype(compute_dtype), linear, s1, s2)
-    per_ex = example_losses(scores, labels.astype(compute_dtype), cfg.loss_type)
+    # scores are f32 regardless of compute_dtype (both score paths
+    # accumulate and return f32), so loss/metrics math stays f32.
+    per_ex = example_losses(scores, labels, cfg.loss_type)
     wsum = jnp.maximum(jnp.sum(weights), 1e-12)
     data_loss = jnp.sum(per_ex * weights) / wsum
     if cfg.factor_lambda or cfg.bias_lambda:
@@ -203,7 +215,7 @@ def loss_and_metrics(
                 params, rows, vals, cfg.factor_lambda, cfg.bias_lambda
             )
     else:
-        reg = jnp.zeros((), compute_dtype)
+        reg = jnp.zeros((), jnp.float32)
     loss = data_loss + reg
     aux = {
         "data_loss": data_loss,

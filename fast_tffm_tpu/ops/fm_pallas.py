@@ -81,30 +81,40 @@ def _m_matrix(f: int, d: int):
     return (cm_iota % d == j_iota).astype(jnp.bfloat16)  # [FD, D]
 
 
-def _dot_f32_rhs(a_f32, b_bf16):
-    """f32-lhs x bf16-0/1-rhs matmul at f32 precision.
+def _dot_f32_rhs(a_f32, b_bf16, *, nsplit: int = 3):
+    """f32-lhs x bf16-0/1-rhs matmul at (up to) f32 precision.
 
     Three-term bf16 split (hi + mid + lo covers ~24 mantissa bits): the
     score's s1^2 - s2 cancellation amplifies relative error, so the
     two-term split's ~2^-17 is not enough here.  Three small bf16 matmuls
     are still negligible next to the kernel's HBM traffic.
+
+    ``nsplit=1`` is for bf16-input mode: when the values came in as bf16
+    the hi term already carries every bit, so the mid/lo matmuls would
+    multiply exact zeros.
     """
     a_hi = a_f32.astype(jnp.bfloat16)
+    out = jax.lax.dot(a_hi, b_bf16, preferred_element_type=jnp.float32)
+    if nsplit == 1:
+        return out
     r1 = a_f32 - a_hi.astype(jnp.float32)
     a_mid = r1.astype(jnp.bfloat16)
     a_lo = (r1 - a_mid.astype(jnp.float32)).astype(jnp.bfloat16)
     return (
-        jax.lax.dot(a_hi, b_bf16, preferred_element_type=jnp.float32)
+        out
         + jax.lax.dot(a_mid, b_bf16, preferred_element_type=jnp.float32)
         + jax.lax.dot(a_lo, b_bf16, preferred_element_type=jnp.float32)
     )
 
 
-def _fwd_kernel(rows_ref, vals_ref, score_ref, s1_ref, *, f, d):
-    rows = rows_ref[...]  # [TB, FD] f32
-    vals = vals_ref[...]  # [TB, F] f32
+def _fwd_kernel(rows_ref, vals_ref, score_ref, s1_ref, *, f, d, nsplit):
+    # bf16-input mode: blocks arrive bf16 (half the HBM traffic of the
+    # kernel's dominant stream) and compute upcasts to f32 — accumulation
+    # precision is unchanged, only the stored rows/vals are rounded.
+    rows = rows_ref[...].astype(jnp.float32)  # [TB, FD]
+    vals = vals_ref[...].astype(jnp.float32)  # [TB, F]
     r_mat, m_mat = _r_matrix(f, d), _m_matrix(f, d)
-    xe = _dot_f32_rhs(vals, r_mat)  # [TB, FD]; one term per column
+    xe = _dot_f32_rhs(vals, r_mat, nsplit=nsplit)  # one term per column
     y = rows * xe
     s = _dot_f32_rhs(y, m_mat)  # [TB, D]: linear | s1
     s2 = _dot_f32_rhs(y * y, m_mat)  # [TB, D]: _ | s2
@@ -114,13 +124,14 @@ def _fwd_kernel(rows_ref, vals_ref, score_ref, s1_ref, *, f, d):
     s1_ref[...] = s1
 
 
-def _bwd_kernel(rows_ref, vals_ref, s1_ref, g_ref, drows_ref, *, f, d):
-    rows = rows_ref[...]  # [TB, FD]
-    vals = vals_ref[...]  # [TB, F]
-    s1 = s1_ref[...]  # [TB, K]
-    g = g_ref[...]  # [TB, 1]
+def _bwd_kernel(rows_ref, vals_ref, s1_ref, g_ref, drows_ref, *, f, d,
+                nsplit):
+    rows = rows_ref[...].astype(jnp.float32)  # [TB, FD]
+    vals = vals_ref[...].astype(jnp.float32)  # [TB, F]
+    s1 = s1_ref[...]  # [TB, K] f32 (saved residual)
+    g = g_ref[...]  # [TB, 1] f32
     fd = f * d
-    xe = _dot_f32_rhs(vals, _r_matrix(f, d))
+    xe = _dot_f32_rhs(vals, _r_matrix(f, d), nsplit=nsplit)
     y = rows * xe
     ones = jnp.ones((s1.shape[0], 1), jnp.float32)
     u = jnp.concatenate([ones, s1], axis=1)  # [TB, D]
@@ -131,7 +142,8 @@ def _bwd_kernel(rows_ref, vals_ref, s1_ref, g_ref, drows_ref, *, f, d):
     s1e = _dot_f32_rhs(u, mt_mat)  # [TB, FD]; one term per column
     c_iota = jax.lax.broadcasted_iota(jnp.int32, (1, fd), 1)
     maskv = (c_iota % d != 0).astype(jnp.float32)  # kill w column in y
-    drows_ref[...] = (g * xe) * (s1e - y * maskv)
+    drows = (g * xe) * (s1e - y * maskv)
+    drows_ref[...] = drows.astype(drows_ref.dtype)  # bf16 out in bf16 mode
 
 
 def _pad_batch(b: int) -> int:
@@ -155,8 +167,9 @@ def fm_scores_pallas(rows: jax.Array, vals: jax.Array, interpret: bool = False):
     bytes_per_row = 4 * (2 * _pad128(fd) + _pad128(f))
     tb = _block_b(bp, bytes_per_row)
     grid = (bp // tb,)
+    nsplit = 1 if rows.dtype == jnp.bfloat16 else 3
     scores, s1 = pl.pallas_call(
-        functools.partial(_fwd_kernel, f=f, d=d),
+        functools.partial(_fwd_kernel, f=f, d=d, nsplit=nsplit),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tb, fd), lambda i: (i, 0)),
@@ -166,9 +179,11 @@ def fm_scores_pallas(rows: jax.Array, vals: jax.Array, interpret: bool = False):
             pl.BlockSpec((tb, 1), lambda i: (i, 0)),
             pl.BlockSpec((tb, d - 1), lambda i: (i, 0)),
         ],
+        # Scores and the s1 residual stay f32 even in bf16-input mode:
+        # the loss and the backward's s1 broadcast want full precision.
         out_shape=[
-            jax.ShapeDtypeStruct((bp, 1), rows.dtype),
-            jax.ShapeDtypeStruct((bp, d - 1), rows.dtype),
+            jax.ShapeDtypeStruct((bp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bp, d - 1), jnp.float32),
         ],
         interpret=interpret,
     )(rows2, vals)
@@ -197,8 +212,9 @@ def fm_grad_pallas(
     bytes_per_row = 4 * (3 * _pad128(fd) + _pad128(f))
     tb = _block_b(bp, bytes_per_row)
     grid = (bp // tb,)
+    nsplit = 1 if rows.dtype == jnp.bfloat16 else 3
     drows = pl.pallas_call(
-        functools.partial(_bwd_kernel, f=f, d=d),
+        functools.partial(_bwd_kernel, f=f, d=d, nsplit=nsplit),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tb, fd), lambda i: (i, 0)),

@@ -22,6 +22,11 @@ from fast_tffm_tpu.platform import use_interpret as _use_interpret
 
 
 def _scores_jnp(rows, vals):
+    # Upcast once: in bf16-input mode only the STORED rows/vals are
+    # rounded — accumulation and the returned scores/s1 stay f32, matching
+    # the Pallas kernels' contract.
+    rows = rows.astype(jnp.float32)
+    vals = vals.astype(jnp.float32)
     w = rows[..., 0]
     v = rows[..., 1:]
     xv = v * vals[..., None]
@@ -32,10 +37,14 @@ def _scores_jnp(rows, vals):
 
 
 def _grads_jnp(rows, vals, s1, g):
+    in_dtype = rows.dtype
+    rows = rows.astype(jnp.float32)
+    vals = vals.astype(jnp.float32)
     v = rows[..., 1:]
     gx = (g[:, None] * vals)[..., None]  # [B, F, 1]
     dv = gx * (s1[:, None, :] - v * vals[..., None])
-    return jnp.concatenate([gx, dv], axis=-1)
+    # Cotangent dtype must match the primal's (bf16 in bf16 mode).
+    return jnp.concatenate([gx, dv], axis=-1).astype(in_dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

@@ -39,11 +39,13 @@ log = logging.getLogger(__name__)
 class MetricState(NamedTuple):
     loss_sum: jax.Array  # weighted sum of per-example data losses
     weight_sum: jax.Array
+    count: jax.Array  # UNWEIGHTED number of real (weight>0) examples
     auc: metrics_lib.AucState
 
     @staticmethod
     def zeros() -> "MetricState":
         return MetricState(
+            jnp.zeros((), jnp.float32),
             jnp.zeros((), jnp.float32),
             jnp.zeros((), jnp.float32),
             metrics_lib.auc_init(),
@@ -64,6 +66,7 @@ def _metric_update(
     return MetricState(
         loss_sum=ms.loss_sum + lsum,
         weight_sum=ms.weight_sum + wsum,
+        count=ms.count + jnp.sum((weights > 0).astype(jnp.float32)),
         auc=metrics_lib.auc_update(ms.auc, scores, labels, weights),
     )
 
@@ -81,6 +84,7 @@ def make_train_step(cfg: FmConfig, optimizer):
                 batch.fields if cfg.field_num else None,
                 batch.weights,
                 cfg,
+                compute_dtype=cfg.compute_jnp_dtype,
             )
 
         (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
@@ -115,9 +119,9 @@ def make_sparse_train_step(cfg: FmConfig, mesh=None):
     )
     if use_shardmap and not shardmap_step.supports_shardmap(cfg, mesh):
         raise ValueError(
-            "lookup=shardmap needs plain FM (field_num=0), optimizer in "
-            "adagrad/ftrl/sgd, batch-mode L2, and a vocabulary divisible "
-            f"by model_shards*{sparse_lib.sparse_apply.TILE}"
+            "lookup=shardmap needs optimizer in adagrad/ftrl/sgd, "
+            "batch-mode L2, and a vocabulary divisible by "
+            f"model_shards*{sparse_lib.sparse_apply.TILE}"
         )
 
     def step(state: TrainState, batch: Batch) -> TrainState:
@@ -157,13 +161,18 @@ def make_eval_step(cfg: FmConfig):
 
 def _finalize_metrics(ms: MetricState, loss_type: str = "logistic") -> dict:
     """Streaming means. The loss key is "logloss" for logistic training and
-    "mse" for mse training (plus a loss_type-agnostic "loss" alias)."""
+    "mse" for mse training (plus a loss_type-agnostic "loss" alias).
+
+    ``examples`` is the UNWEIGHTED count of real examples (a weighted run
+    used to report weight-sums as examples, inflating/deflating rates);
+    ``weight_sum`` carries the loss normalizer separately."""
     wsum = max(float(ms.weight_sum), 1e-12)
     loss = float(ms.loss_sum) / wsum
     out = {
         "loss": loss,
         "auc": float(metrics_lib.auc_finalize(ms.auc)),
-        "examples": float(ms.weight_sum),
+        "examples": float(ms.count),
+        "weight_sum": float(ms.weight_sum),
     }
     out["mse" if loss_type == "mse" else "logloss"] = loss
     return out
@@ -306,7 +315,8 @@ class Trainer:
         batch is assembled shard-by-shard in mesh_lib.shard_batch.  Hosts
         that share a data block (model-axis-spanning processes) must
         produce bit-identical batches in identical order, so their
-        pipelines run single-threaded (ordered)."""
+        pipelines run ordered (parallel parse, sequence-ordered
+        delivery)."""
         import dataclasses
 
         n_procs = jax.process_count()
@@ -347,6 +357,19 @@ class Trainer:
             checkpoint.restore_data_state(cfg.model_file)
             if self._restored_step else None
         )
+        if ds is not None:
+            # The position only means "continue where we stopped" under
+            # the SAME stream definition: seed, batch size, file list.
+            # A changed config would make the skip land on the wrong data
+            # — warn and start the epoch from scratch instead.
+            fp = ds.get("fingerprint")
+            if fp is not None and fp != self._data_fingerprint():
+                log.warning(
+                    "checkpoint data position was saved under a different "
+                    "input config (seed/batch_size/files changed); "
+                    "ignoring it and reading the epoch from the start"
+                )
+                ds = None
         if ds is not None and 0 <= ds.get("epoch", -1) < cfg.epoch_num:
             resume_epoch = int(ds["epoch"])
             resume_skip = int(ds.get("batches_done", 0))
@@ -358,15 +381,23 @@ class Trainer:
         metrics_out = (
             open(cfg.metrics_file, "a") if cfg.metrics_file else None
         )
-        pipe_cfg, shard, ordered = self._input_plan()
+        pipe_cfg, shard, _ = self._input_plan()
         profiling = False
         t0 = time.time()
         last_log_t, last_log_ex = t0, 0.0
         stepno = 0
+        trunc_base, trunc_logged = 0, 0
         try:
             for epoch in range(resume_epoch, cfg.epoch_num):
                 self._epoch = epoch
                 self._batches_done = resume_skip if epoch == resume_epoch else 0
+                # ordered=True always for training: delivery follows the
+                # (seeded, deterministic) reader order, so the saved
+                # batches_done position identifies EXACTLY the prefix that
+                # trained — with free-running workers a mid-epoch resume
+                # could double- or never-train boundary batches.  Parsing
+                # still fans out to thread_num workers (sequence-numbered
+                # delivery), so this costs no throughput.
                 pipeline = BatchPipeline(
                     cfg.train_files,
                     pipe_cfg,
@@ -376,7 +407,7 @@ class Trainer:
                     seed=cfg.seed + epoch,
                     skip_batches=self._batches_done,
                     shard=shard,
-                    ordered=ordered,
+                    ordered=True,
                 )
                 for batch in pipeline:
                     if cfg.profile_dir and stepno == cfg.profile_start_step:
@@ -407,6 +438,19 @@ class Trainer:
                             stepno, int(m["examples"]), m["loss"], m["auc"],
                             rate,
                         )
+                        # Surface parser truncation (reference FmParser
+                        # warned; silently vanishing features hide data
+                        # bugs like a too-small max_features).
+                        cur_trunc = trunc_base + pipeline.truncated_features
+                        if cur_trunc > trunc_logged:
+                            log.warning(
+                                "%d feature occurrences dropped by "
+                                "max_features=%d since last report "
+                                "(total %d)",
+                                cur_trunc - trunc_logged, cfg.max_features,
+                                cur_trunc,
+                            )
+                            trunc_logged = cur_trunc
                         if metrics_out is not None:
                             metrics_out.write(json.dumps({
                                 "step": stepno,
@@ -436,8 +480,14 @@ class Trainer:
                             metrics_out.flush()
                     if cfg.save_steps and stepno % cfg.save_steps == 0:
                         self.save(stepno)
+                trunc_base += pipeline.truncated_features
             self._epoch = cfg.epoch_num
             self._batches_done = 0
+            if trunc_base > trunc_logged:
+                log.warning(
+                    "%d feature occurrences dropped by max_features=%d "
+                    "over the run", trunc_base, cfg.max_features,
+                )
         finally:
             # An abandoned trace poisons any later start_trace in-process.
             if profiling:
@@ -472,6 +522,20 @@ class Trainer:
             ms = self._eval_step(self.state.params, ms, self._put(batch))
         return _finalize_metrics(ms, self.cfg.loss_type)
 
+    def _data_fingerprint(self) -> dict:
+        """Identity of the training input stream; the saved data position
+        is only valid for an identical stream.  Everything that changes
+        batch composition or order belongs here: files, batch size, seed,
+        the shuffle window, and which ingest path (they shuffle with
+        different RNG streams)."""
+        return {
+            "seed": self.cfg.seed,
+            "batch_size": self.cfg.batch_size,
+            "train_files": list(self.cfg.train_files),
+            "shuffle_buffer": self.cfg.shuffle_buffer,
+            "fast_ingest": self.cfg.fast_ingest,
+        }
+
     def save(self, stepno: int):
         checkpoint.save(
             self.cfg.model_file,
@@ -481,6 +545,7 @@ class Trainer:
             data_state={
                 "epoch": self._epoch,
                 "batches_done": self._batches_done,
+                "fingerprint": self._data_fingerprint(),
             },
         )
 

@@ -20,9 +20,17 @@ the hand-laid-out alternative, exploiting FM's algebra (SURVEY.md §7 step
     K1+K-place kernels, psum'd over the data axis (the sync-DP gradient
     allreduce), then the optimizer formula applied elementwise in place.
 
-Scope: plain FM with the sparse row-local optimizers (adagrad/ftrl/sgd)
-and batch-mode (or zero) L2.  FFM and dense optimizers stay on the
-GSPMD-auto path.
+Scope: FM and field-aware FM with the sparse row-local optimizers
+(adagrad/ftrl/sgd) and batch-mode (or zero) L2.  Dense optimizers stay on
+the GSPMD-auto path.
+
+FFM uses the same inversion (BASELINE config 5): the field-grouped sums
+``S[b,p,q,:] = sum_{i: f_i=p} v_i^q x_i`` are linear in per-feature
+contributions, so each shard computes a partial S from ITS rows and one
+psum completes it; the closed-form backward
+``dv_i^q = g x_i (S[q, f_i] - [q=f_i] v_i^{f_i} x_i)`` needs only the
+completed S plus the shard's own rows — no row exchange, exactly like
+FM's s1.
 """
 
 from __future__ import annotations
@@ -44,8 +52,6 @@ from fast_tffm_tpu.train.sparse import (
 
 
 def supports_shardmap(cfg: FmConfig, mesh) -> bool:
-    if cfg.field_num:
-        return False
     if cfg.optimizer not in ("adagrad", "ftrl", "sgd"):
         return False
     if cfg.l2_mode != "batch" and (cfg.factor_lambda or cfg.bias_lambda):
@@ -100,57 +106,128 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
     k = cfg.factor_num
     n_opt = len(_opt_tables(cfg, opt_state))
 
-    def device_fn(w0, table_l, labels, ids, vals, weights, *opt_tables_l):
-        m = jax.lax.axis_index(MODEL_AXIS)
-        row_lo = m * vocab_local
-        local = (ids >= row_lo) & (ids < row_lo + vocab_local)  # [b, F]
-        lids = jnp.where(local, ids - row_lo, 0)
-        maskf = local.astype(jnp.float32)
-        rows = table_l[lids] * maskf[..., None]  # [b, F, D], 0 off-shard
-        w = rows[..., 0]
-        v = rows[..., 1:]
-        xv = v * vals[..., None]
+    cd = cfg.compute_jnp_dtype
+
+    def _fm_fwd_bwd(w0, rows, vals, labels, weights):
+        """Plain FM: partial (linear, s1, s2) -> psum -> closed-form grad."""
+        w = rows[..., 0].astype(cd)
+        v = rows[..., 1:].astype(cd)
+        vals_c = vals.astype(cd)
+        xv = v * vals_c[..., None]
         # Partial terms from this shard's rows; psum over model completes
         # them — the entire "lookup" is this [b, 2k+1] collective.
         terms = jnp.concatenate(
             [
-                jnp.sum(w * vals, axis=-1, keepdims=True),  # linear
-                jnp.sum(xv, axis=1),  # s1 [b, k]
-                jnp.sum(xv * xv, axis=1),  # s2 [b, k]
+                jnp.sum(w * vals_c, axis=-1, keepdims=True,
+                        dtype=jnp.float32),  # linear
+                jnp.sum(xv, axis=1, dtype=jnp.float32),  # s1 [b, k]
+                jnp.sum(xv * xv, axis=1, dtype=jnp.float32),  # s2 [b, k]
             ],
             axis=-1,
         )
         terms = jax.lax.psum(terms, MODEL_AXIS)
         linear, s1, s2 = terms[:, 0], terms[:, 1:1 + k], terms[:, 1 + k:]
         scores = w0 + linear + 0.5 * jnp.sum(s1 * s1 - s2, axis=-1)
+        g, gx = _g_gx(scores, labels, weights, vals)
+        # Closed-form FmGrad for the occurrences this shard owns.
+        dv = gx[..., None] * (s1[:, None, :] - xv.astype(jnp.float32))
+        return scores, g, jnp.concatenate([gx[..., None], dv], axis=-1)
+
+    def _ffm_fwd_bwd(w0, rows, vals, fields, labels, weights):
+        """Field-aware FM, same inversion: the field-grouped sums
+        S[b,p,q,:] are per-shard-linear, so partial S + ONE psum replaces
+        the row exchange; backward needs only the complete S plus own
+        rows: dv_i^q = g x_i (S[q, f_i] - [q=f_i] v_i^{f_i} x_i)."""
+        p_num = cfg.field_num
+        b, f = vals.shape
+        w = rows[..., 0].astype(cd)
+        v = rows[..., 1:].astype(cd).reshape(b, f, p_num, k)
+        vals_c = vals.astype(cd)
+        oh = (
+            fields[..., None] == jnp.arange(p_num, dtype=fields.dtype)
+        ).astype(cd)  # [b, F, P]
+        linear_p = jnp.sum(w * vals_c, axis=-1, dtype=jnp.float32)
+        s_p = jnp.einsum(
+            "bfp,bfqk->bpqk", oh * vals_c[..., None], v,
+            preferred_element_type=jnp.float32,
+        )  # [b, P, P, k] partial field-grouped sums
+        v_own = jnp.einsum(
+            "bfq,bfqk->bfk", oh, v, preferred_element_type=jnp.float32
+        )  # v_i^{f_i}, zero off-shard (v is masked)
+        self_p = jnp.sum(
+            jnp.sum(v_own * v_own, axis=-1) * (vals * vals), axis=-1
+        )
+        terms = jnp.concatenate(
+            [linear_p[:, None], self_p[:, None],
+             s_p.reshape(b, p_num * p_num * k)],
+            axis=-1,
+        )
+        terms = jax.lax.psum(terms, MODEL_AXIS)
+        linear, self_t = terms[:, 0], terms[:, 1]
+        s_full = terms[:, 2:].reshape(b, p_num, p_num, k)
+        cross = jnp.einsum("bpqk,bqpk->b", s_full, s_full)
+        scores = w0 + linear + 0.5 * (cross - self_t)
+        g, gx = _g_gx(scores, labels, weights, vals)
+        oh32 = oh.astype(jnp.float32)
+        # T[b,f,q,:] = S[b, q, f_i, :] — gather S's second field axis by
+        # each occurrence's own field, as a one-hot matmul.
+        t = jnp.einsum("bqpk,bfp->bfqk", s_full, oh32)
+        dv = gx[..., None, None] * (
+            t
+            - oh32[..., None] * v_own[:, :, None, :] * vals[..., None, None]
+        )  # [b, F, P, k]
+        return scores, g, jnp.concatenate(
+            [gx[..., None], dv.reshape(b, f, p_num * k)], axis=-1
+        )
+
+    def _g_gx(scores, labels, weights, vals):
         # Global weighted-mean loss: normalizer spans the data axis.
         wsum = jax.lax.psum(jnp.sum(weights), DATA_AXIS)
         g = weights * _dscore(scores, labels, cfg.loss_type) / jnp.maximum(
             wsum, 1e-12
         )  # [b] dL/dscore
-        # Closed-form FmGrad for the occurrences this shard owns.
-        gx = g[:, None] * vals * maskf  # [b, F]
-        dv = gx[..., None] * (s1[:, None, :] - xv)  # [b, F, k]
-        drows = jnp.concatenate([gx[..., None], dv], axis=-1)  # [b, F, D]
+        return g, g[:, None] * vals  # gx [b, F]; caller masks via rows
+
+    def device_fn(w0, table_l, labels, ids, vals, fields, weights,
+                  *opt_tables_l):
+        m = jax.lax.axis_index(MODEL_AXIS)
+        row_lo = m * vocab_local
+        local = (ids >= row_lo) & (ids < row_lo + vocab_local)  # [b, F]
+        lids = jnp.where(local, ids - row_lo, 0)
+        maskf = local.astype(jnp.float32)
+        rows = table_l[lids] * maskf[..., None]  # [b, F, D], 0 off-shard
+        # bf16 mode (cd) rounds the [b, F, D] interaction operands (the
+        # step's dominant HBM streams); sums accumulate f32, and the
+        # psum'd terms, backward, and optimizer stay f32.
+        if cfg.field_num:
+            scores, g, drows = _ffm_fwd_bwd(
+                w0, rows, vals, fields, labels, weights
+            )
+        else:
+            scores, g, drows = _fm_fwd_bwd(w0, rows, vals, labels, weights)
+        # Only occurrences this shard owns update its rows.
+        drows = drows * maskf[..., None]
         if cfg.factor_lambda or cfg.bias_lambda:
             # d/drow of l2_penalty_batch: 2*lambda*row/B per occurrence.
             bsz = jax.lax.psum(jnp.float32(vals.shape[0]), DATA_AXIS)
             lam = jnp.concatenate([
                 jnp.full((1,), cfg.bias_lambda, jnp.float32),
-                jnp.full((k,), cfg.factor_lambda, jnp.float32),
+                jnp.full(
+                    (rows.shape[-1] - 1,), cfg.factor_lambda, jnp.float32
+                ),
             ])
             occ = (vals != 0).astype(jnp.float32)[..., None] * maskf[..., None]
             drows = drows + (2.0 / bsz) * lam * rows * occ
         # Local-coordinate occurrence list; off-shard -> sentinel row.
         b, f = vals.shape
+        d = rows.shape[-1]  # 1 + k (FM) or 1 + field_num*k (FFM)
         ids_flat = jnp.where(local, ids - row_lo, vocab_local).reshape(b * f)
-        g_flat = drows.reshape(b * f, 1 + k)
+        g_flat = drows.reshape(b * f, d)
         delta = sparse_apply.dense_delta(
             ids_flat.astype(jnp.int32), g_flat,
             vocab=vocab_local, vocab_local=vocab_local, row_lo=0,
         )
         delta = jax.lax.psum(delta, DATA_AXIS)
-        d = 1 + k
         dw0 = jax.lax.psum(jnp.sum(g), DATA_AXIS)
         if cfg.bias_lambda:
             # l2_penalty_batch includes bias_lambda*w0^2/B — its w0 grad
@@ -171,14 +248,14 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
         mesh=mesh,
         in_specs=(
             (P(), P(MODEL_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None),
-             P(DATA_AXIS, None), P(DATA_AXIS))
+             P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))
             + (P(MODEL_AXIS, None),) * n_opt
         ),
         out_specs=out_specs,
         check_vma=False,  # pallas_call outputs carry no vma annotations
     )(
         params.w0, params.table, batch.labels, batch.ids, batch.vals,
-        batch.weights, *_opt_tables(cfg, opt_state),
+        batch.fields, batch.weights, *_opt_tables(cfg, opt_state),
     )
     table_new, scores, dw0 = outs[0], outs[1], outs[2]
     new_opt_tables = outs[3:]

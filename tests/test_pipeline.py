@@ -125,9 +125,9 @@ def test_raw_groups_cross_chunk_boundaries(tmp_path):
     groups = list(_iter_raw_groups([str(path)], batch_size=10, chunk_bytes=17))
     parser = native.NativeParser(1000, 4, num_threads=1)
     got = []
-    for buf, off in groups:
-        assert len(off) - 1 <= 10
-        b = parser.parse_raw(buf, off, 10)
+    for buf, starts, ends in groups:
+        assert len(starts) <= 10
+        b = parser.parse_raw(buf, starts, ends, 10)
         got.extend(b.ids[b.vals > 0].tolist())
     assert got == list(range(257))
 
@@ -144,7 +144,7 @@ def test_raw_groups_pack_across_file_boundaries(tmp_path):
     b.write_bytes(b"1 3:1.0\n1 4:1.0\n1 5:1.0\n1 6:1.0\n")
     groups = list(_iter_raw_groups([str(a), str(b)], batch_size=4))
     parser = native.NativeParser(1000, 4, num_threads=1)
-    batches = [parser.parse_raw(buf, off, 4) for buf, off in groups]
+    batches = [parser.parse_raw(buf, s, e, 4) for buf, s, e in groups]
     # 7 lines -> one full group of 4 (spanning the file boundary) + tail 3.
     assert [int((bb.weights > 0).sum()) for bb in batches] == [4, 3]
     got = [i for bb in batches for i in bb.ids[bb.vals > 0].tolist()]
@@ -156,9 +156,9 @@ def test_raw_parse_blank_and_comment_weight_zero(tmp_path):
 
     buf = b"1 5:1.0\n\n# comment\n0 7:2.0\n"
     starts = native.find_line_offsets(buf)
-    offsets = np.append(starts, len(buf))
+    ends = np.append(starts[1:], len(buf))
     parser = native.NativeParser(100, 4, num_threads=1)
-    b = parser.parse_raw(buf, offsets, 8)
+    b = parser.parse_raw(buf, starts, ends, 8)
     np.testing.assert_array_equal(b.weights[:4], [1, 0, 0, 1])
     assert b.ids[0, 0] == 5 and b.ids[3, 0] == 7
 
@@ -186,6 +186,45 @@ def test_raw_pipeline_matches_line_pipeline(tmp_path):
         np.testing.assert_array_equal(bf.vals, bl.vals)
         np.testing.assert_array_equal(bf.labels, bl.labels)
         np.testing.assert_array_equal(bf.weights, bl.weights)
+
+
+def test_fast_ingest_line_level_shuffle_mixes_sorted_labels(tmp_path):
+    """A label-sorted file (the norm for CTR logs) must yield label-mixed
+    batches under fast ingest: the shuffle permutes LINES within a
+    shuffle_buffer window, not just batch-group order — group-granularity
+    shuffling would deliver single-label batches no matter the order."""
+    path = tmp_path / "sorted.libsvm"
+    n = 4096
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(f"{0 if i < n // 2 else 1} {i % 97}:1.0\n")
+    cfg = _cfg(batch_size=64, shuffle_buffer=2048, thread_num=2)
+    assert cfg.fast_ingest
+    mixed = 0
+    total = 0
+    for b in BatchPipeline([str(path)], cfg, epochs=1, shuffle=True, seed=3):
+        labels = b.labels[b.weights > 0]
+        total += 1
+        if 0 < labels.sum() < len(labels):
+            mixed += 1
+    assert total == n // 64
+    # With line-level mixing virtually every batch holds both labels.
+    assert mixed / total > 0.9
+
+
+def test_pipeline_ordered_parallel_matches_single_thread(data_files):
+    """ordered=True must deliver identical batches in identical order
+    regardless of thread_num (model-axis-spanning hosts rely on this) —
+    parsing fans out to workers, delivery reorders by sequence number."""
+    one = _keys(BatchPipeline(
+        data_files, _cfg(thread_num=1), epochs=2, shuffle=True, seed=5,
+        ordered=True,
+    ))
+    four = _keys(BatchPipeline(
+        data_files, _cfg(thread_num=4), epochs=2, shuffle=True, seed=5,
+        ordered=True,
+    ))
+    assert one == four
 
 
 def test_pipeline_drop_remainder(data_files):

@@ -67,7 +67,8 @@ def test_trainer_resumes_mid_epoch(tmp_path, rng):
     # Simulate an interruption at batch 5 of epoch 0: rewrite the saved
     # data position (params/opt stay as saved).
     ds = checkpoint.restore_data_state(cfg.model_file)
-    assert ds == {"epoch": 1, "batches_done": 0}  # completed run
+    assert ds["epoch"] == 1 and ds["batches_done"] == 0  # completed run
+    assert ds["fingerprint"]["seed"] == cfg.seed
     with open(f"{cfg.model_file}/data_state.json", "w") as f:
         json.dump({"epoch": 0, "batches_done": 5}, f)
 
@@ -75,9 +76,8 @@ def test_trainer_resumes_mid_epoch(tmp_path, rng):
     assert t2._restored_step == 8  # warm start from the checkpoint
     r2 = t2.train()
     assert r2["train"]["steps"] == 3  # only the remaining 3 batches
-    assert checkpoint.restore_data_state(cfg.model_file) == {
-        "epoch": 1, "batches_done": 0,
-    }
+    ds2 = checkpoint.restore_data_state(cfg.model_file)
+    assert ds2["epoch"] == 1 and ds2["batches_done"] == 0
 
 
 def test_stale_data_state_ignored_without_params(tmp_path, rng):
@@ -104,6 +104,72 @@ def test_completed_checkpoint_warm_starts_full_epochs(tmp_path, rng):
     Trainer(cfg).train()
     r2 = Trainer(cfg).train()
     assert r2["train"]["steps"] == 8
+
+
+def test_resume_position_ignored_on_config_change(tmp_path, rng, caplog):
+    """A saved data position under a DIFFERENT input config (seed, batch
+    size, file list) must be ignored with a warning — skipping N batches
+    of a differently-defined stream lands on the wrong data."""
+    import logging
+
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path)
+    Trainer(cfg).train()
+    ds = checkpoint.restore_data_state(cfg.model_file)
+    ds.update({"epoch": 0, "batches_done": 5})  # fingerprint: seed=3
+    with open(f"{cfg.model_file}/data_state.json", "w") as f:
+        json.dump(ds, f)
+
+    cfg2 = _cfg(tmp_path, seed=99)  # stream redefined
+    with caplog.at_level(logging.WARNING):
+        r = Trainer(cfg2).train()
+    assert r["train"]["steps"] == 8  # full epoch, position ignored
+    assert any("different input config" in rec.message for rec in caplog.records)
+
+
+def test_resume_exact_with_parallel_parsing(tmp_path, rng):
+    """Mid-epoch resume with thread_num>1: training pipelines are ordered
+    (sequence-numbered delivery), so batches_done identifies exactly the
+    trained prefix — no boundary batch is doubled or skipped."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, thread_num=4)
+    Trainer(cfg).train()
+    ds = checkpoint.restore_data_state(cfg.model_file)
+    ds.update({"epoch": 0, "batches_done": 5})
+    with open(f"{cfg.model_file}/data_state.json", "w") as f:
+        json.dump(ds, f)
+    r2 = Trainer(cfg).train()
+    assert r2["train"]["steps"] == 3
+
+
+def test_truncation_warning_logged(tmp_path, rng, caplog):
+    """Features dropped by max_features must surface in the log (the
+    reference's parser warned; silent truncation hides data bugs)."""
+    import logging
+
+    path = tmp_path / "train.libsvm"
+    with open(path, "w") as f:
+        for i in range(64):
+            toks = " ".join(f"{(i + j) % 64}:1.0" for j in range(6))
+            f.write(f"{i % 2} {toks}\n")
+    cfg = _cfg(tmp_path, max_features=4)  # 2 of 6 features dropped per line
+    with caplog.at_level(logging.WARNING):
+        Trainer(cfg).train()
+    msgs = [r.message for r in caplog.records if "dropped by" in r.message]
+    assert msgs and "max_features=4" in msgs[0]
+    assert "128" in msgs[0]  # 64 lines x 2 dropped
+
+
+def test_weighted_metrics_report_unweighted_examples(tmp_path, rng):
+    """examples = unweighted real-example count; weight_sum separate."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    wpath = tmp_path / "w.txt"
+    with open(wpath, "w") as f:
+        f.write("2.5\n" * 256)
+    cfg = _cfg(tmp_path, weight_files=[str(wpath)])
+    r = Trainer(cfg).train()
+    assert r["train"]["examples"] == 256.0  # not 256 * 2.5
+    assert abs(r["train"]["weight_sum"] - 256 * 2.5) < 1e-3
 
 
 def test_periodic_validation(tmp_path, rng):

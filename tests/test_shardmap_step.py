@@ -160,7 +160,7 @@ def test_supports_shardmap_gating():
     mesh = _mesh((4, 2))
     ok = dict(vocabulary_size=V, factor_num=K, max_features=8)
     assert shardmap_step.supports_shardmap(FmConfig(**ok), mesh)
-    assert not shardmap_step.supports_shardmap(
+    assert shardmap_step.supports_shardmap(  # FFM rides the same inversion
         FmConfig(field_num=3, **ok), mesh
     )
     assert not shardmap_step.supports_shardmap(
@@ -168,4 +168,76 @@ def test_supports_shardmap_gating():
     )
     assert not shardmap_step.supports_shardmap(
         FmConfig(l2_mode="full", factor_lambda=0.1, **ok), mesh
+    )
+
+
+def _ffm_batch(seed, p_num, b=64, f=8):
+    rng = np.random.default_rng(seed)
+    return Batch(
+        labels=rng.integers(0, 2, b).astype(np.float32),
+        ids=rng.integers(0, V, (b, f)).astype(np.int32),
+        vals=rng.uniform(0.1, 1.0, (b, f)).astype(np.float32),
+        fields=rng.integers(0, p_num, (b, f)).astype(np.int32),
+        weights=np.ones((b,), np.float32),
+    )
+
+
+@pytest.mark.parametrize("optimizer", ["adagrad", "ftrl"])
+def test_shardmap_ffm_matches_scatter(optimizer):
+    """FFM on the shardmap path: partial-S psum + closed-form backward
+    must reproduce the einsum-oracle + autodiff scatter path."""
+    mesh = _mesh((2, 4))
+    p_num = 4
+    cfg = FmConfig(
+        vocabulary_size=V, factor_num=K, max_features=8, batch_size=64,
+        field_num=p_num, optimizer=optimizer, learning_rate=0.05,
+        ftrl_l1=0.01, ftrl_l2=0.1, lookup="shardmap",
+    )
+    assert shardmap_step.supports_shardmap(cfg, mesh)
+    batch = jax.tree.map(jnp.asarray, _ffm_batch(11, p_num))
+    params = fm.init_params(jax.random.PRNGKey(4), cfg)
+    opt = sparse_lib.init_sparse_opt_state(cfg, params)
+
+    p_sm, o_sm = params, opt
+    step_sm = jax.jit(
+        lambda p, o, b: shardmap_step.sparse_step_shardmap(cfg, p, o, b, mesh)
+    )
+    sm_scores = None
+    for _ in range(2):
+        p_sm, o_sm, sm_scores = step_sm(p_sm, o_sm, batch)
+
+    p_sc, o_sc = params, opt
+    step_sc = jax.jit(lambda p, o, b: sparse_lib.sparse_step(cfg, p, o, b))
+    sc_scores = None
+    for _ in range(2):
+        p_sc, o_sc, sc_scores = step_sc(p_sc, o_sc, batch)
+
+    np.testing.assert_allclose(sm_scores, sc_scores, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(p_sm.table, p_sc.table, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        float(p_sm.w0), float(p_sc.w0), rtol=1e-5, atol=1e-7
+    )
+
+
+def test_shardmap_ffm_with_l2_matches_scatter():
+    mesh = _mesh((2, 4))
+    p_num = 3
+    cfg = FmConfig(
+        vocabulary_size=V, factor_num=K, max_features=8, batch_size=64,
+        field_num=p_num, optimizer="adagrad", learning_rate=0.05,
+        factor_lambda=0.01, bias_lambda=0.002, l2_mode="batch",
+        lookup="shardmap",
+    )
+    batch = jax.tree.map(jnp.asarray, _ffm_batch(12, p_num))
+    params = fm.init_params(jax.random.PRNGKey(5), cfg)
+    opt = sparse_lib.init_sparse_opt_state(cfg, params)
+    p_sm, o_sm, _ = jax.jit(
+        lambda p, o, b: shardmap_step.sparse_step_shardmap(cfg, p, o, b, mesh)
+    )(params, opt, batch)
+    p_sc, o_sc, _ = jax.jit(
+        lambda p, o, b: sparse_lib.sparse_step(cfg, p, o, b)
+    )(params, opt, batch)
+    np.testing.assert_allclose(p_sm.table, p_sc.table, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        o_sm.acc.table, o_sc.acc.table, rtol=1e-4, atol=1e-5
     )

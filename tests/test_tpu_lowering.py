@@ -69,24 +69,31 @@ class TestSparseApplyLowering:
 
 
 class TestFmKernelLowering:
-    def test_forward(self):
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_forward(self, dtype):
         lower_tpu(
             functools.partial(fm_pallas.fm_scores_pallas, interpret=False),
-            _s((B, F, 1 + K)), _s((B, F)),
+            _s((B, F, 1 + K), dtype), _s((B, F), dtype),
         )
 
-    def test_backward(self):
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_backward(self, dtype):
         lower_tpu(
             functools.partial(fm_pallas.fm_grad_pallas, interpret=False),
-            _s((B, F, 1 + K)), _s((B, F)), _s((B, K)), _s((B,)),
+            _s((B, F, 1 + K), dtype), _s((B, F), dtype), _s((B, K)),
+            _s((B,)),
         )
 
 
 class TestFullStepLowering:
     """The exact step functions the trainer jits, lowered for TPU."""
 
+    def test_single_device_tile_step_bf16(self):
+        """The bf16-compute variant of the full tile step lowers too."""
+        self.test_single_device_tile_step("adagrad", "bfloat16")
+
     @pytest.mark.parametrize("optimizer", ["adagrad", "ftrl", "sgd"])
-    def test_single_device_tile_step(self, optimizer):
+    def test_single_device_tile_step(self, optimizer, compute_dtype="float32"):
         from fast_tffm_tpu.config import FmConfig
         from fast_tffm_tpu.data.libsvm import Batch
         from fast_tffm_tpu.models import fm
@@ -95,7 +102,7 @@ class TestFullStepLowering:
         cfg = FmConfig(
             vocabulary_size=V, factor_num=K, max_features=F,
             batch_size=B, optimizer=optimizer, sparse_apply="tile",
-            use_pallas=True,
+            use_pallas=True, compute_dtype=compute_dtype,
         )
         params = fm.FmParams(w0=_s(()), table=_s((V, 1 + K)))
         opt = sparse.init_sparse_opt_state(
@@ -113,8 +120,12 @@ class TestFullStepLowering:
 
         lower_tpu(step, params, opt, batch)
 
+    def test_shardmap_step_ffm(self):
+        """FFM variant of the hand-sharded step lowers for TPU too."""
+        self.test_shardmap_step("adagrad", field_num=4)
+
     @pytest.mark.parametrize("optimizer", ["adagrad", "ftrl"])
-    def test_shardmap_step(self, optimizer):
+    def test_shardmap_step(self, optimizer, field_num=0):
         """The hand-sharded multi-device step over the virtual 8-dev mesh."""
         import numpy as np
         from jax.sharding import Mesh
@@ -132,12 +143,13 @@ class TestFullStepLowering:
         cfg = FmConfig(
             vocabulary_size=V, factor_num=K, max_features=F,
             batch_size=B, optimizer=optimizer, sparse_apply="tile",
-            use_pallas=True,
+            use_pallas=True, field_num=field_num,
         )
+        d = cfg.embedding_dim
         assert shardmap_step.supports_shardmap(cfg, mesh)
-        params = fm.FmParams(w0=_s(()), table=_s((V, 1 + K)))
+        params = fm.FmParams(w0=_s(()), table=_s((V, d)))
         opt = sparse.init_sparse_opt_state(
-            cfg, fm.FmParams(w0=jnp.zeros(()), table=jnp.zeros((V, 1 + K)))
+            cfg, fm.FmParams(w0=jnp.zeros(()), table=jnp.zeros((V, d)))
         )
         opt = jax.tree.map(lambda a: _s(a.shape, a.dtype), opt)
         batch = Batch(

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Ingest-component microbench: scan vs parse vs pipeline, threads x rate.
+
+Measures the three stages of the fast-ingest path separately so the
+bottleneck is visible (SURVEY.md §7 hard-part 2: the parser must feed the
+chips):
+
+  scan      — _iter_raw_windows: chunked reads + ONE C++ memchr pass
+  parse     — NativeParser.parse_raw over pre-scanned groups, 1 C++ thread
+  pipeline  — BatchPipeline end-to-end drain (reader + N parse workers +
+              shuffle), the rate training actually sees
+
+Prints a JSON line per measurement; run with no args on any machine.
+Results are committed to INGEST.md with the host's core count — rates
+scale with cores since parse workers are independent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import shutil
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+BATCH, NFEAT, VOCAB = 4096, 39, 1 << 20
+
+
+def main() -> int:
+    from bench import _gen_libsvm_files
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.data import native as native_lib
+    from fast_tffm_tpu.data.pipeline import BatchPipeline, _iter_raw_groups
+
+    tmpdir = tempfile.mkdtemp(prefix="ingest_bench_")
+    try:
+        rng = np.random.default_rng(7)
+        files = _gen_libsvm_files(tmpdir, rng, 4, 8 * BATCH, NFEAT, VOCAB)
+        total = 4 * 8 * BATCH
+        size = sum(os.path.getsize(f) for f in files)
+        print(json.dumps({
+            "setup": {"lines": total, "mb": round(size / 1e6, 1),
+                      "cpus": os.cpu_count(), "batch": BATCH,
+                      "features": NFEAT},
+        }))
+
+        def emit(stage, rate, **kw):
+            print(json.dumps({
+                "stage": stage, "lines_per_sec": round(rate), **kw
+            }))
+
+        for _ in range(2):  # second pass = warm page cache
+            t0 = time.perf_counter()
+            n = 0
+            for _, starts, _e in _iter_raw_groups(files, BATCH):
+                n += len(starts)
+            scan = n / (time.perf_counter() - t0)
+        emit("scan", scan)
+
+        groups = list(_iter_raw_groups(files, BATCH))
+        for nt in (1, 2, 4):
+            p = native_lib.NativeParser(VOCAB, NFEAT, False, 0, nt)
+            p.parse_raw(*groups[0], BATCH)
+            t0 = time.perf_counter()
+            for g in groups:
+                p.parse_raw(*g, BATCH)
+            emit("parse", total / (time.perf_counter() - t0),
+                 internal_threads=nt)
+
+        for tn in (1, 2, 4, 8):
+            for ordered in (False, True):
+                cfg = FmConfig(
+                    vocabulary_size=VOCAB, factor_num=8, max_features=NFEAT,
+                    batch_size=BATCH, thread_num=tn, queue_size=8,
+                )
+                pipe = BatchPipeline(
+                    files, cfg, epochs=2, shuffle=not ordered,
+                    ordered=ordered,
+                )
+                t0 = time.perf_counter()
+                n = 0
+                for _b in pipe:
+                    n += BATCH
+                emit("pipeline", n / (time.perf_counter() - t0),
+                     thread_num=tn, ordered=ordered)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
