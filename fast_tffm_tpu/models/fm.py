@@ -92,6 +92,12 @@ def ffm_scores_from_rows(
     naive [B,F,F,k] pairwise tensor (a ~800MB intermediate at Criteo
     shapes, built by row gathers) with two einsum-matmuls over [B,P,P,k].
     """
+    from fast_tffm_tpu.platform import ffm_compute_dtype
+
+    # Off-TPU the einsum operands fall back to f32 (XLA:CPU cannot run
+    # bf16 dots) — see platform.ffm_compute_dtype, the one copy of that
+    # gate.
+    compute_dtype = ffm_compute_dtype(compute_dtype)
     rows = rows.astype(compute_dtype)
     vals = vals.astype(compute_dtype)
     b, f = vals.shape

@@ -73,6 +73,29 @@ def force_compiled():
         _force_compiled = prev
 
 
+def ffm_compute_dtype(compute_dtype):
+    """The dtype FFM's einsum operands may use on the current target.
+
+    XLA:CPU's DotThunk cannot EXECUTE bf16 x bf16 -> f32 dots (runtime
+    UNIMPLEMENTED; inside a shard_map the aborting device strands the
+    others at the next collective).  The TPU MXU runs them natively, so
+    bf16 passes through on a TPU backend — and under
+    :func:`force_compiled` (cross-platform lowering FOR TPU on a CPU
+    host), where falling back would make lowering tests silently
+    validate the f32 program instead of the advertised bf16 one.
+
+    The ONE copy of this gate; fm.ffm_scores_from_rows and the shardmap
+    FFM step both call it.
+    """
+    import jax.numpy as jnp
+
+    if compute_dtype == jnp.bfloat16 and not (
+        _force_compiled or is_tpu_backend()
+    ):
+        return jnp.float32
+    return compute_dtype
+
+
 def pin_cpu(n_devices: int | None = None) -> None:
     """Force the CPU platform, optionally with ``n_devices`` virtual CPUs.
 

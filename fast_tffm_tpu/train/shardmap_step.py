@@ -138,14 +138,17 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
         S[b,p,q,:] are per-shard-linear, so partial S + ONE psum replaces
         the row exchange; backward needs only the complete S plus own
         rows: dv_i^q = g x_i (S[q, f_i] - [q=f_i] v_i^{f_i} x_i)."""
+        from fast_tffm_tpu.platform import ffm_compute_dtype
+
+        ffm_cd = ffm_compute_dtype(cd)  # f32 off-TPU: CPU can't bf16-dot
         p_num = cfg.field_num
         b, f = vals.shape
-        w = rows[..., 0].astype(cd)
-        v = rows[..., 1:].astype(cd).reshape(b, f, p_num, k)
-        vals_c = vals.astype(cd)
+        w = rows[..., 0].astype(ffm_cd)
+        v = rows[..., 1:].astype(ffm_cd).reshape(b, f, p_num, k)
+        vals_c = vals.astype(ffm_cd)
         oh = (
             fields[..., None] == jnp.arange(p_num, dtype=fields.dtype)
-        ).astype(cd)  # [b, F, P]
+        ).astype(ffm_cd)  # [b, F, P]
         linear_p = jnp.sum(w * vals_c, axis=-1, dtype=jnp.float32)
         s_p = jnp.einsum(
             "bfp,bfqk->bpqk", oh * vals_c[..., None], v,

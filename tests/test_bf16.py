@@ -131,6 +131,55 @@ class TestTrainingParity:
         assert t.state.params.table.dtype == jnp.float32  # params stay f32
 
 
+class TestFfmBf16:
+    def test_ffm_scores_bf16_close_to_f32(self, rng):
+        """FFM bf16 mode must RUN off-TPU (XLA:CPU cannot execute
+        bf16 x bf16 -> f32 dots, so the einsums fall back to f32 operands
+        there) and stay close to f32 scores."""
+        b, f, p, k = 64, 8, 3, 4
+        w0 = jnp.float32(0.1)
+        rows = jnp.asarray(rng.normal(0, 0.1, (b, f, 1 + p * k)), jnp.float32)
+        vals = jnp.asarray(rng.uniform(0.1, 1.0, (b, f)), jnp.float32)
+        fields = jnp.asarray(rng.integers(0, p, (b, f)), jnp.int32)
+        ref = fm.ffm_scores_from_rows(w0, rows, vals, fields, k, p)
+        got = fm.ffm_scores_from_rows(
+            w0, rows, vals, fields, k, p, jnp.bfloat16
+        )
+        assert got.dtype == jnp.float32
+        np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.02)
+
+    def test_ffm_shardmap_bf16_runs_on_mesh(self, rng):
+        """The FFM+bf16 shardmap step must execute on a CPU mesh (the
+        multichip dryrun config; a bf16 dot would abort one device and
+        strand the rest at the next collective)."""
+        from jax.sharding import Mesh
+
+        from fast_tffm_tpu.parallel import mesh as mesh_lib
+        from fast_tffm_tpu.train import shardmap_step
+
+        mesh = Mesh(
+            np.array(jax.devices()[:8]).reshape(4, 2),
+            (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS),
+        )
+        cfg = _cfg(
+            field_num=3, compute_dtype="bfloat16", sparse_apply="tile",
+            use_pallas=False,
+        )
+        params, opt = _init(cfg)
+        brng = np.random.default_rng(9)
+        batch = _batch(brng, cfg.batch_size, cfg.max_features,
+                       cfg.vocabulary_size)
+        batch = batch._replace(
+            fields=brng.integers(
+                0, 3, (cfg.batch_size, cfg.max_features)
+            ).astype(np.int32)
+        )
+        p, o, scores = shardmap_step.sparse_step_shardmap(
+            cfg, params, opt, batch, mesh
+        )
+        assert np.isfinite(np.asarray(scores)).all()
+
+
 class TestShardmapBf16:
     def test_shardmap_bf16_close_to_f32(self, rng):
         from jax.sharding import Mesh
