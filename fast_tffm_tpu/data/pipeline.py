@@ -474,12 +474,17 @@ def _make_parser(cfg: FmConfig):
     try:
         from fast_tffm_tpu.data import native as _native
 
+        # Parallelism comes from the pipeline's thread_num WORKERS (each
+        # parses a different group with the GIL released); internal C++
+        # threads on top would oversubscribe cores (thread_num^2) and a
+        # per-group fork/join barrier pipelines worse than independent
+        # groups anyway.
         native = _native.NativeParser(
             vocabulary_size=cfg.vocabulary_size,
             max_features=cfg.max_features,
             hash_feature_id=cfg.hash_feature_id,
             field_num=cfg.field_num,
-            num_threads=max(1, cfg.thread_num),
+            num_threads=1,
         )
     except Exception as e:  # pragma: no cover - env-dependent
         log.info("native parser unavailable (%s); using Python parser", e)

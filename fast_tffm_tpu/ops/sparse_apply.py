@@ -38,14 +38,32 @@ correction per row (FTRL).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-CHUNK = 512  # C: sorted occurrences per K1 grid step
-TILE = 256  # R: table rows per K2 grid step (also the K2 window size)
+# Block sizes, overridable via env for hardware tuning (the grid-overhead
+# vs MXU-work tradeoff is a chip property; tools/tpu_validate.py
+# --sweep-blocks measures it).  Both must be multiples of 8 (sublanes);
+# TILE additionally gates supports_tile's vocab-divisibility check.
+def _env_block(name: str, default: int) -> int:
+    raw = os.environ.get(name, str(default))
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+    if val <= 0 or val % 8:
+        raise ValueError(
+            f"{name}={val} must be a positive multiple of 8 (sublanes)"
+        )
+    return val
+
+
+CHUNK = _env_block("FAST_TFFM_K1_CHUNK", 512)
+TILE = _env_block("FAST_TFFM_K2_TILE", 256)
 
 
 def ftrl_solve(z, n, lr, l1, l2, beta):
@@ -184,7 +202,8 @@ def _placed_sums(u_vmem, cnt, d, tile):
     u = jnp.where(mask, u_vmem[...], 0.0)  # [R, L]
     # Tile-local row as int32 for the iota compare: tpu.iota is
     # integer-only (a f32 iota fails Mosaic verification).  The f32 value
-    # is exact (< R <= 256), so the cast is too.
+    # is exact for any TILE < 2^24 (f32 integers are exact below that),
+    # so the cast is too.
     lrow = u[:, 2 * d:2 * d + 1].astype(jnp.int32)  # [R, 1] tile-local row
     r_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
     p = ((lrow == r_iota) & mask).astype(jnp.bfloat16)  # [entry, row]

@@ -67,6 +67,23 @@ class TestSparseApplyLowering:
             _s((N,), jnp.int32), _s((N, D)),
         )
 
+    @pytest.mark.parametrize("chunk,tile", [(256, 512), (1024, 512),
+                                            (2048, 256)])
+    def test_adagrad_apply_alternate_blocks(self, chunk, tile):
+        """The tunable CHUNK/TILE values the hardware sweep tries must
+        all pass Mosaic lowering, or the sweep would crash the chip run."""
+        orig = sparse_apply.CHUNK, sparse_apply.TILE
+        sparse_apply.CHUNK, sparse_apply.TILE = chunk, tile
+        try:
+            lower_tpu(
+                functools.partial(
+                    sparse_apply.adagrad_apply, lr=0.1, eps=1e-7
+                ),
+                _s((V, D)), _s((V, D)), _s((N,), jnp.int32), _s((N, D)),
+            )
+        finally:
+            sparse_apply.CHUNK, sparse_apply.TILE = orig
+
 
 class TestFmKernelLowering:
     @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])

@@ -57,6 +57,12 @@ def main() -> int:
         "--out", default="",
         help="also write a markdown report (e.g. TPU_RESULTS.md)",
     )
+    ap.add_argument(
+        "--sweep-blocks", action="store_true",
+        help="time K1/K2 across CHUNK/TILE sizes (grid-overhead vs MXU "
+        "tradeoff is hardware-dependent; sweep on the chip, then pin "
+        "winners via FAST_TFFM_K1_CHUNK / FAST_TFFM_K2_TILE)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -169,6 +175,34 @@ def main() -> int:
         f"  tile vs scatter speedup: "
         f"{t['scatter_adagrad_apply'] / t['tile_adagrad_apply']:.1f}x"
     )
+
+    if args.sweep_blocks:
+        # K1 runs N/CHUNK sequential grid steps (per-step overhead) with
+        # one-hot matmul work growing ~CHUNK per occurrence; K2's TILE
+        # fixes the window DMA size and placement-matmul shape.  The
+        # optimum is a hardware property — measure, don't guess.
+        emit("block-size sweep (ms):")
+        orig_chunk, orig_tile = sparse_apply.CHUNK, sparse_apply.TILE
+        try:
+            for chunk in (256, 512, 1024, 2048):
+                sparse_apply.CHUNK = chunk
+                ms = bench(
+                    jax.jit(lambda tb, a, i, gg: sparse_apply.adagrad_apply(
+                        tb, a, i, gg, lr=lr, eps=eps)),
+                    table, acc, ids, g_rows)
+                emit(f"  K1 CHUNK={chunk:5d} (TILE={orig_tile}): {ms:9.3f}")
+            sparse_apply.CHUNK = orig_chunk
+            for tile in (256, 512):
+                if V % tile:
+                    continue
+                sparse_apply.TILE = tile
+                ms = bench(
+                    jax.jit(lambda tb, a, i, gg: sparse_apply.adagrad_apply(
+                        tb, a, i, gg, lr=lr, eps=eps)),
+                    table, acc, ids, g_rows)
+                emit(f"  K2 TILE={tile:6d} (CHUNK={orig_chunk}): {ms:9.3f}")
+        finally:
+            sparse_apply.CHUNK, sparse_apply.TILE = orig_chunk, orig_tile
 
     # ---- 3. full steps -------------------------------------------------
     import shutil
