@@ -56,6 +56,14 @@ inline bool IsSpace(char c) {
          c == '\f';
 }
 
+// Blank/comment test shared by both batch entry points (their rows get
+// weight 0; ParseLine keeps its own early-return as a safety net for
+// direct calls, where such a row merely stays zeroed).
+inline bool BlankOrComment(const char* s, const char* e) {
+  while (s < e && IsSpace(*s)) ++s;
+  return s >= e || *s == '#';
+}
+
 struct Parser {
   uint64_t vocabulary_size;
   int max_features;
@@ -185,10 +193,21 @@ inline bool ParseFloat(const char* s, const char* e, float* out) {
     *out = static_cast<float>(neg ? -v : v);
     return true;
   }
+  // strtod accepts forms Python's float() rejects: hex floats ("0x10",
+  // via 'x') and nan payloads ("nan(chars)", via '(').  The Python
+  // oracle symmetrically rejects forms strtod can't parse (underscore
+  // literals, Unicode digits); both sides pin to the ASCII intersection.
+  for (const char* q = s; q < e; ++q) {
+    if (*q == 'x' || *q == 'X' || *q == '(') return false;
+  }
   char* endp = nullptr;
-  float v = std::strtof(s, &endp);
+  // strtod then cast, NOT strtof: Python parses to float64 and numpy
+  // rounds that to float32 (double rounding).  strtof's single rounding
+  // differs by an ULP on >15-significant-digit tokens near f32 tie
+  // midpoints — the oracle's two-step path is the contract.
+  double v = std::strtod(s, &endp);
   if (endp != e || s == e) return false;
-  *out = v;
+  *out = static_cast<float>(v);
   return true;
 }
 
@@ -351,8 +370,11 @@ void fm_parser_destroy(void* handle) { delete static_cast<Parser*>(handle); }
 // Parse n_lines lines (buf + offsets, offsets has n_lines+1 entries) into
 // the first n_lines rows of the [batch_size, max_features] outputs.  All
 // output arrays must be pre-zeroed by the caller (padding convention).
-// weights_in may be null (-> 1.0 for parsed rows).  Returns total dropped
-// (truncated) feature count, or -1 if any line was malformed.
+// weights_in may be null (-> 1.0 for parsed rows).  Blank/comment lines
+// become weight-0 rows (same convention as parse_raw — a weight-1 empty
+// row would train w0 on a phantom label-0 example).  Returns total
+// dropped (truncated) feature count, or -(first_bad_index + 1) if a
+// line was malformed (callers decode the line number from it).
 int64_t fm_parser_parse(void* handle, const char* buf,
                         const int64_t* offsets, int64_t n_lines,
                         float* labels, int32_t* ids, float* vals,
@@ -360,8 +382,13 @@ int64_t fm_parser_parse(void* handle, const char* buf,
                         const float* weights_in) {
   const Parser& p = *static_cast<Parser*>(handle);
   return RunLines(p, n_lines, [&](int64_t i, int64_t* local_dropped) {
-    int d = ParseLine(p, buf + offsets[i], buf + offsets[i + 1], i, labels,
-                      ids, vals, fields);
+    const char* s = buf + offsets[i];
+    const char* e = buf + offsets[i + 1];
+    if (BlankOrComment(s, e)) {
+      weights[i] = 0.0f;
+      return true;
+    }
+    int d = ParseLine(p, s, e, i, labels, ids, vals, fields);
     if (d < 0) return false;
     *local_dropped += d;
     weights[i] = weights_in ? weights_in[i] : 1.0f;
@@ -409,11 +436,7 @@ int64_t fm_parser_parse_raw(void* handle, const char* buf,
   return RunLines(p, n_lines, [&](int64_t i, int64_t* local_dropped) {
     const char* s = buf + starts[i];
     const char* e = buf + ends[i];
-    // Blank/comment lines become weight-0 rows (the raw-chunk path has no
-    // Python-side blank filtering); detection mirrors ParseLine's trim.
-    const char* t = s;
-    while (t < e && IsSpace(*t)) ++t;
-    if (t >= e || *t == '#') {
+    if (BlankOrComment(s, e)) {
       weights[i] = 0.0f;
       return true;
     }

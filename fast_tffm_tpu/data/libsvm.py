@@ -20,9 +20,34 @@ Supported line formats:
 
 from __future__ import annotations
 
+import re
 from typing import Iterable, NamedTuple, Optional, Sequence
 
 import numpy as np
+
+# Strict numeric token grammar, shared spec with the C++ parser: plain
+# Python float()/int() accept forms C parsing rejects (underscore
+# literals "1_0", Unicode digits), and C's strtof accepts forms Python
+# rejects (hex floats "0x10", nan payloads "nan(x)").  Both sides pin to
+# the ASCII intersection; a round-4 fuzz (test_native_parser) found the
+# divergences.
+_FLOAT_RE = re.compile(
+    r"[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|[+-]?(?:inf(?:inity)?|nan)",
+    re.IGNORECASE | re.ASCII,
+)
+_INT_RE = re.compile(r"[+-]?\d+", re.ASCII)
+
+
+def _strict_float(token: str) -> float:
+    if not _FLOAT_RE.fullmatch(token):
+        raise ValueError(f"could not convert string to float: {token!r}")
+    return float(token)
+
+
+def _strict_int(token: str) -> int:
+    if not _INT_RE.fullmatch(token):
+        raise ValueError(f"invalid literal for int(): {token!r}")
+    return int(token)
 
 _MASK64 = (1 << 64) - 1
 _M = 0xC6A4A7935BD1E995
@@ -88,7 +113,7 @@ def parse_line(
     if not line or line.startswith("#"):
         return None
     parts = line.split()
-    label = float(parts[0])
+    label = _strict_float(parts[0])
     # The reference trains logistic loss on CTR labels; accept {-1,1} and
     # {0,1} conventions by folding -1 to 0.
     if label == -1.0:
@@ -100,7 +125,7 @@ def parse_line(
         pieces = tok.split(":")
         if len(pieces) == 3:
             field_s, id_s, val_s = pieces
-            field = int(field_s)
+            field = _strict_int(field_s)
         elif len(pieces) == 2:
             field = 0
             id_s, val_s = pieces
@@ -112,11 +137,11 @@ def parse_line(
         if hash_feature_id:
             fid = hash_bucket(id_s, vocabulary_size)
         else:
-            fid = int(id_s) % vocabulary_size
+            fid = _strict_int(id_s) % vocabulary_size
         if field_num:
             field = field % field_num
         ids.append(fid)
-        vals.append(float(val_s))
+        vals.append(_strict_float(val_s))
         fields.append(field)
     return Example(label, ids, vals, fields)
 

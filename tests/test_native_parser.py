@@ -64,6 +64,65 @@ def test_native_matches_oracle(built, rng, ffm, hash_ids):
     np.testing.assert_array_equal(got.weights, want.weights)
 
 
+def test_fuzz_native_matches_oracle_on_adversarial_tokens(built):
+    """Seeded fuzz over pathological tokens: both parsers must agree on
+    ACCEPT vs REJECT for every line, and bit-exactly on accepted values.
+    Found in round 4: Python accepted underscore literals ("1_0") and
+    strtof accepted hex floats ("0x10") / nan payloads — both sides now
+    pin to the strict ASCII grammar."""
+    frags = [
+        "1", "0", "-1", "2.5", ".5", "+.5", "-0.25", "1e5", "1E-3", "nan",
+        "inf", "-inf", "infinity", "0x1p3", "1_000", "00123", "", "abc",
+        "1.2.3", "1..2", "+", "-", ":", "::", "1:", ":1", "1:2:3:4", "%",
+        "123456789012345678901234567890", "1:+2", "1:-2e-2", "1:nan",
+        "1:0x10", "1:1_0", "007:1", "1.", "5:.5", "3:1e", "2:1.5e+2",
+        # Double-rounding traps: >15 significant digits near f32 tie
+        # midpoints — native must strtod-then-cast like Python+numpy,
+        # not single-round with strtof.
+        "1:16777217.0000000000000001", "1:0.10000000000000000555",
+        "2:33554433.0000000000000001",
+    ]
+    rng = np.random.default_rng(42)
+    parser = native.NativeParser(1000, 8, num_threads=1)
+    for _ in range(2000):
+        n = int(rng.integers(1, 5))
+        line = " ".join(rng.choice(frags) for _ in range(n))
+        if not line.strip() or line.lstrip().startswith("#"):
+            continue  # blank/comment conventions tested separately
+        try:
+            want = libsvm.make_batch(
+                libsvm.parse_lines([line], 1000, False, 0), 1, 8
+            )
+            oracle_ok = True
+        except ValueError:
+            oracle_ok = False
+        try:
+            got = parser.parse_batch([line], 1)
+            native_ok = True
+        except ValueError:
+            native_ok = False
+        assert oracle_ok == native_ok, (
+            f"accept/reject mismatch (oracle={oracle_ok}) on {line!r}"
+        )
+        if oracle_ok:
+            for f in libsvm.Batch._fields:
+                np.testing.assert_array_equal(
+                    getattr(got, f), getattr(want, f),
+                    err_msg=f"{f} mismatch on {line!r}",
+                )
+
+
+def test_parse_batch_blank_and_comment_weight_zero(built):
+    """parse_batch keeps row alignment: blank/comment lines become
+    weight-0 rows (a weight-1 empty row would train on a phantom
+    example)."""
+    got = native.NativeParser(100, 4, num_threads=1).parse_batch(
+        ["1 5:1.0", "", "# note", "0 7:2.0"], 4
+    )
+    np.testing.assert_array_equal(got.weights, [1, 0, 0, 1])
+    assert got.ids[0, 0] == 5 and got.ids[3, 0] == 7
+
+
 def test_native_truncation_counted(built):
     parser = native.NativeParser(100, 2, num_threads=1)
     parser.parse_batch(["1 1:1 2:1 3:1 4:1"], batch_size=1)
