@@ -26,3 +26,19 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def set_data_state(model_file: str, **fields) -> None:
+    """Rewrite checkpointed input-pipeline position fields, preserving the
+    saved stream fingerprint — the shared way tests simulate a mid-epoch
+    interruption (tests that deliberately write a raw/fingerprint-less
+    data_state.json to exercise back-compat keep doing so inline)."""
+    import json
+
+    from fast_tffm_tpu.train import checkpoint
+
+    ds = checkpoint.restore_data_state(model_file)
+    assert ds is not None, f"no data_state in {model_file}"
+    ds.update(fields)
+    with open(f"{model_file}/data_state.json", "w") as f:
+        json.dump(ds, f)

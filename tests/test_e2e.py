@@ -191,6 +191,62 @@ max_features = 8
     assert os.path.exists(tmp_path / "scores_cli.txt")
 
 
+@pytest.mark.slow
+def test_kitchen_sink_ffm_bf16_weights_resume_predict(tmp_path, rng):
+    """Every subsystem at once: field-aware FM + bf16 compute + weight
+    files (line ingest path) + periodic validation/save + metrics JSONL +
+    mid-epoch resume + predict.  Interaction bugs between features hide
+    from single-feature tests."""
+    import json
+
+    from fast_tffm_tpu.train import checkpoint
+
+    n, p_num = 512, 3
+    train = tmp_path / "train.libsvm"
+    with open(train, "w") as f:
+        for i in range(n):
+            toks = " ".join(
+                f"{rng.integers(0, p_num)}:{rng.integers(0, 200)}:"
+                f"{rng.uniform(0.1, 1):.4f}"
+                for _ in range(6)
+            )
+            f.write(f"{i % 2} {toks}\n")
+    wf = tmp_path / "w.txt"
+    wf.write_text("1.5\n" * n)
+
+    cfg = FmConfig(
+        vocabulary_size=256, factor_num=4, field_num=p_num, max_features=8,
+        batch_size=64, epoch_num=2, learning_rate=0.1,
+        compute_dtype="bfloat16",
+        train_files=[str(train)], weight_files=[str(wf)],
+        validation_files=[str(train)], validation_steps=5,
+        predict_files=[str(train)], score_path=str(tmp_path / "scores.txt"),
+        model_file=str(tmp_path / "model"),
+        metrics_file=str(tmp_path / "metrics.jsonl"),
+        save_steps=6, log_steps=4, thread_num=2, seed=1,
+    )
+    r1 = Trainer(cfg).train()
+    assert r1["train"]["steps"] == 16  # 8 batches x 2 epochs
+    assert r1["train"]["examples"] == 1024.0  # unweighted count
+    assert abs(r1["train"]["weight_sum"] - 1024 * 1.5) < 1e-3
+    assert np.isfinite(r1["validation"]["logloss"])
+    recs = [json.loads(line) for line in open(cfg.metrics_file)]
+    assert any("validation_loss" in r for r in recs)
+
+    # Simulate an interruption at epoch 1, batch 3; resume finishes the
+    # remaining 5 batches of that epoch (+ nothing else).
+    from conftest import set_data_state
+
+    set_data_state(cfg.model_file, epoch=1, batches_done=3)
+    r2 = Trainer(cfg).train()
+    assert r2["train"]["steps"] == 5
+
+    n_scores = predict(cfg)
+    assert n_scores == n
+    scores = [float(s) for s in open(cfg.score_path)]
+    assert all(0.0 <= s <= 1.0 for s in scores)
+
+
 def test_metrics_file_and_profiler(tmp_path, rng):
     """Observability: metrics JSONL stream + jax.profiler trace dir."""
     import json

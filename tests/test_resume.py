@@ -114,11 +114,10 @@ def test_resume_position_ignored_on_config_change(tmp_path, rng, caplog):
 
     _write_data(tmp_path / "train.libsvm", rng)
     cfg = _cfg(tmp_path)
+    from conftest import set_data_state
+
     Trainer(cfg).train()
-    ds = checkpoint.restore_data_state(cfg.model_file)
-    ds.update({"epoch": 0, "batches_done": 5})  # fingerprint: seed=3
-    with open(f"{cfg.model_file}/data_state.json", "w") as f:
-        json.dump(ds, f)
+    set_data_state(cfg.model_file, epoch=0, batches_done=5)  # fp: seed=3
 
     cfg2 = _cfg(tmp_path, seed=99)  # stream redefined
     with caplog.at_level(logging.WARNING):
@@ -131,13 +130,12 @@ def test_resume_exact_with_parallel_parsing(tmp_path, rng):
     """Mid-epoch resume with thread_num>1: training pipelines are ordered
     (sequence-numbered delivery), so batches_done identifies exactly the
     trained prefix — no boundary batch is doubled or skipped."""
+    from conftest import set_data_state
+
     _write_data(tmp_path / "train.libsvm", rng)
     cfg = _cfg(tmp_path, thread_num=4)
     Trainer(cfg).train()
-    ds = checkpoint.restore_data_state(cfg.model_file)
-    ds.update({"epoch": 0, "batches_done": 5})
-    with open(f"{cfg.model_file}/data_state.json", "w") as f:
-        json.dump(ds, f)
+    set_data_state(cfg.model_file, epoch=0, batches_done=5)
     r2 = Trainer(cfg).train()
     assert r2["train"]["steps"] == 3
 
