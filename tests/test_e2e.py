@@ -199,8 +199,6 @@ def test_kitchen_sink_ffm_bf16_weights_resume_predict(tmp_path, rng):
     from single-feature tests."""
     import json
 
-    from fast_tffm_tpu.train import checkpoint
-
     n, p_num = 512, 3
     train = tmp_path / "train.libsvm"
     with open(train, "w") as f:
