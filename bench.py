@@ -275,6 +275,8 @@ def main() -> int:
         from fast_tffm_tpu.config import FmConfig
         from fast_tffm_tpu.train.loop import Trainer
 
+        workers = min(16, max(4, (os.cpu_count() or 4) - 2))
+
         def make_cfg(**overrides):
             c = FmConfig(
                 vocabulary_size=1 << 22 if on_tpu else 1 << 20,
@@ -284,12 +286,13 @@ def main() -> int:
                 learning_rate=0.05,
                 model_file="/tmp/fast_tffm_tpu_bench_model",
                 log_steps=0,
-                thread_num=min(16, max(4, (os.cpu_count() or 4) - 2)),
-                # Small queues: with deep queues the parser threads can
-                # finish the whole (finite) dataset during warmup and the
-                # "e2e" timed region would measure dequeue-only
-                # throughput, not ingest.
-                queue_size=2,
+                thread_num=workers,
+                # One queued group per worker: shallower starves parallel
+                # parsers on multi-core hosts, deeper just front-loads
+                # parsing (the timed-region sizing below scales with the
+                # in-flight bound so warmup can't pre-parse the measured
+                # region either way).
+                queue_size=workers,
                 **overrides,
             )
             shutil.rmtree(c.model_file, ignore_errors=True)
