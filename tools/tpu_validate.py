@@ -183,24 +183,33 @@ def main() -> int:
         # optimum is a hardware property — measure, don't guess.
         emit("block-size sweep (ms):")
         orig_chunk, orig_tile = sparse_apply.CHUNK, sparse_apply.TILE
-        try:
-            for chunk in (256, 512, 1024, 2048):
-                sparse_apply.CHUNK = chunk
+
+        def try_candidate(label):
+            # Fail-soft: Mosaic VMEM allocation happens at COMPILE time
+            # (the big candidates' one-hot intermediates approach the
+            # ~16MB scoped-VMEM limit), which cross-platform lowering
+            # tests cannot check — a losing candidate must not kill the
+            # hardware window.
+            try:
                 ms = bench(
                     jax.jit(lambda tb, a, i, gg: sparse_apply.adagrad_apply(
                         tb, a, i, gg, lr=lr, eps=eps)),
                     table, acc, ids, g_rows)
-                emit(f"  K1 CHUNK={chunk:5d} (TILE={orig_tile}): {ms:9.3f}")
+                emit(f"  {label}: {ms:9.3f}")
+            except Exception as exc:  # noqa: BLE001
+                emit(f"  {label}: FAILED {type(exc).__name__}: "
+                     f"{str(exc).splitlines()[0][:150]}")
+
+        try:
+            for chunk in (256, 512, 1024, 2048):
+                sparse_apply.CHUNK = chunk
+                try_candidate(f"K1 CHUNK={chunk:5d} (TILE={orig_tile})")
             sparse_apply.CHUNK = orig_chunk
             for tile in (256, 512):
                 if V % tile:
                     continue
                 sparse_apply.TILE = tile
-                ms = bench(
-                    jax.jit(lambda tb, a, i, gg: sparse_apply.adagrad_apply(
-                        tb, a, i, gg, lr=lr, eps=eps)),
-                    table, acc, ids, g_rows)
-                emit(f"  K2 TILE={tile:6d} (CHUNK={orig_chunk}): {ms:9.3f}")
+                try_candidate(f"K2 TILE={tile:6d} (CHUNK={orig_chunk})")
         finally:
             sparse_apply.CHUNK, sparse_apply.TILE = orig_chunk, orig_tile
 
