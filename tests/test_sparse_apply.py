@@ -133,6 +133,37 @@ def test_adagrad_multi_step_training_converges():
     assert after < before - 0.1, (before, after)
 
 
+@pytest.mark.parametrize("chunk,tile", [(256, 512), (1024, 256)])
+def test_adagrad_matches_scatter_alternate_blocks(chunk, tile):
+    """The tunable CHUNK/TILE candidates must stay numerically exact,
+    not just compile: the hardware sweep would otherwise crown a
+    fast-but-wrong block size.  Hot ids span multiple chunks at both
+    chunk sizes."""
+    orig = sparse_apply.CHUNK, sparse_apply.TILE
+    sparse_apply.CHUNK, sparse_apply.TILE = chunk, tile
+    try:
+        # n leaves plenty of non-hot ids at both chunk sizes: the hot
+        # run spans 2+ chunks AND chunks still mix distinct ids (an
+        # all-one-id batch would degenerate the placement coverage).
+        ids, g = _ids_grads(3, 4096, hot=chunk * 2 + 100)
+        table = _table(0)
+        acc = jnp.full((V, D), 0.1, jnp.float32)
+        t_tile, a_tile = sparse_apply.adagrad_apply(
+            table, acc, ids, g, lr=0.1, eps=1e-7
+        )
+        a_ref = acc.at[ids].add(g * g)
+        t_ref = table.at[ids].add(
+            -0.1 * g * jax.lax.rsqrt(a_ref[ids] + 1e-7)
+        )
+        # atol 5e-6: the bf16 hi/lo-split one-hot matmuls accumulate in
+        # different orders per chunk size (~1e-6 jitter); real block-size
+        # logic errors (mis-placed carries/windows) show at 1e-2+.
+        np.testing.assert_allclose(t_tile, t_ref, rtol=2e-5, atol=5e-6)
+        np.testing.assert_allclose(a_tile, a_ref, rtol=2e-5, atol=5e-6)
+    finally:
+        sparse_apply.CHUNK, sparse_apply.TILE = orig
+
+
 def test_supports_tile_gating():
     assert sparse_apply.supports_tile(2048, "adagrad")
     assert not sparse_apply.supports_tile(100, "adagrad")  # not TILE-aligned

@@ -189,13 +189,26 @@ def main() -> int:
             # (the big candidates' one-hot intermediates approach the
             # ~16MB scoped-VMEM limit), which cross-platform lowering
             # tests cannot check — a losing candidate must not kill the
-            # hardware window.
+            # hardware window.  Each candidate is also verified against
+            # the scatter reference: a fast-but-WRONG block size must
+            # never win the sweep.
             try:
-                ms = bench(
-                    jax.jit(lambda tb, a, i, gg: sparse_apply.adagrad_apply(
-                        tb, a, i, gg, lr=lr, eps=eps)),
-                    table, acc, ids, g_rows)
-                emit(f"  {label}: {ms:9.3f}")
+                fn = jax.jit(
+                    lambda tb, a, i, gg: sparse_apply.adagrad_apply(
+                        tb, a, i, gg, lr=lr, eps=eps)
+                )
+                t_c, a_c = fn(table, acc, ids, g_rows)
+                err = max(
+                    float(jnp.max(jnp.abs(t_c - t_ref))),
+                    float(jnp.max(jnp.abs(a_c - a_ref))),
+                )
+                # Free the check outputs before timing: two extra (V, D)
+                # arrays held across the bench could OOM a big candidate
+                # that would fit in production.
+                del t_c, a_c
+                ms = bench(fn, table, acc, ids, g_rows)
+                flag = "" if err < 1e-4 else f"  WRONG (err {err:.2e})"
+                emit(f"  {label}: {ms:9.3f}{flag}")
             except Exception as exc:  # noqa: BLE001
                 emit(f"  {label}: FAILED {type(exc).__name__}: "
                      f"{str(exc).splitlines()[0][:150]}")
