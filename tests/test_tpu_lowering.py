@@ -102,6 +102,16 @@ class TestFmKernelLowering:
         )
 
 
+class TestGraftEntryLowering:
+    def test_entry_lowers_with_compiled_pallas(self):
+        """The driver's single-chip compile gate runs entry() — which
+        uses the Pallas forward — so entry must Mosaic-lower for TPU."""
+        import __graft_entry__ as ge
+
+        fn, args = ge.entry()
+        lower_tpu(fn, *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args])
+
+
 class TestFullStepLowering:
     """The exact step functions the trainer jits, lowered for TPU."""
 
