@@ -350,7 +350,7 @@ def dense_delta(ids, g_rows, *, vocab, vocab_local, row_lo):
         sidx, upos, row_lo + jnp.arange(0, vocab_local + 1, TILE,
                                         dtype=sidx.dtype)
     )
-    return _kplace_call(tile_start, u, vocab_local, d, 2 * d + 1)
+    return _kplace_call(tile_start, u, vocab_local, d, u.shape[1])
 
 
 # ------------------------------------------------------------ orchestration
@@ -389,6 +389,20 @@ def _prep(ids, g_rows, vocab):
     payload = jnp.concatenate(
         [g_sorted, g_sorted * g_sorted, (lrow * last)[:, None]], axis=1
     )  # [N, 2D+1]
+    # Pad the minor dim to the 128-lane tile: the unique-entry stream this
+    # payload becomes is DMA'd at dynamic offsets (K1 out, K2/K-place in),
+    # and Mosaic requires manually sliced HBM memrefs to be lane-aligned
+    # ("Slice shape along dimension 1 must be aligned to tiling (128)" on
+    # real v5e — auto-pipelined BlockSpecs pad for free, manual
+    # `.at[pl.ds(...)]` copies do not).  HBM storage is already physically
+    # padded to 128 lanes by tiling, so the zeros cost no extra traffic.
+    lanes = payload.shape[1]
+    lanes_pad = -(-lanes // 128) * 128
+    if lanes_pad != lanes:
+        payload = jnp.concatenate(
+            [payload, jnp.zeros((n_pad, lanes_pad - lanes), payload.dtype)],
+            axis=1,
+        )  # [N, lanes_pad]
     starts = upos[::CHUNK]
     firsts = jnp.concatenate([flags[::CHUNK], jnp.ones((1,), jnp.int32)])
     ends = upos[CHUNK - 1::CHUNK]
@@ -413,7 +427,7 @@ def adagrad_apply(table, acc, ids, g_rows, *, lr, eps):
     kernel = functools.partial(
         _k2_adagrad_kernel, tile=TILE, d=d, lr=lr, eps=eps
     )
-    table, acc = _k2_call(kernel, tile_start, u, (table, acc), 2 * d + 1)
+    table, acc = _k2_call(kernel, tile_start, u, (table, acc), u.shape[1])
     return table, acc
 
 
@@ -421,7 +435,7 @@ def sgd_apply(table, ids, g_rows, *, lr):
     vocab, d = table.shape
     u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
     kernel = functools.partial(_k2_sgd_kernel, tile=TILE, d=d, lr=lr)
-    (table,) = _k2_call(kernel, tile_start, u, (table,), 2 * d + 1)
+    (table,) = _k2_call(kernel, tile_start, u, (table,), u.shape[1])
     return table
 
 
@@ -431,7 +445,7 @@ def ftrl_apply(table, z, n, ids, g_rows, *, lr, l1, l2, beta):
     kernel = functools.partial(
         _k2_ftrl_kernel, tile=TILE, d=d, lr=lr, l1=l1, l2=l2, beta=beta
     )
-    table, z, n = _k2_call(kernel, tile_start, u, (table, z, n), 2 * d + 1)
+    table, z, n = _k2_call(kernel, tile_start, u, (table, z, n), u.shape[1])
     return table, z, n
 
 
