@@ -24,11 +24,11 @@ random-access scatter into sequential streams and MXU matmuls:
    bound that makes the window exact), places them with a one-hot [R, R]
    matmul, and applies the optimizer formula on the whole tile in VPU.
 
-Per step this costs one pass over the table (streaming, bandwidth-bound)
-plus ~1ms of MXU placement matmuls, independent of duplicate structure —
-measured ~10x faster than the XLA scatter path at Criteo shapes (V=2^22,
-B=16k, F=39) and exact to ~1e-6 relative (one-hot matmuls run as two-pass
-bf16 hi/lo splits, keeping ~f32 precision).
+Per step this costs one pass over the table (streaming) plus the MXU
+placement matmuls, independent of duplicate structure — measured 2.3x
+faster than the XLA scatter path on real v5e at Criteo shapes (V=2^22,
+B=16k, F=39; TPU_RESULTS.md) and exact to ~1e-6 relative (one-hot
+matmuls run as two-pass bf16 hi/lo splits, keeping ~f32 precision).
 
 Semantics match train.sparse exactly: per-occurrence g² accumulation,
 shared post-update denominator for duplicates (Adagrad), single -sigma*w
@@ -49,21 +49,32 @@ from jax.experimental.pallas import tpu as pltpu
 # vs MXU-work tradeoff is a chip property; tools/tpu_validate.py
 # --sweep-blocks measures it).  Both must be multiples of 8 (sublanes);
 # TILE additionally gates supports_tile's vocab-divisibility check.
-def _env_block(name: str, default: int) -> int:
+def _env_block(name: str, default: int, multiple: int = 8) -> int:
     raw = os.environ.get(name, str(default))
     try:
         val = int(raw)
     except ValueError:
         raise ValueError(f"{name}={raw!r} is not an integer") from None
-    if val <= 0 or val % 8:
-        raise ValueError(
-            f"{name}={val} must be a positive multiple of 8 (sublanes)"
+    if val <= 0 or val % multiple:
+        kind = (
+            f"a positive multiple of {multiple} (sublanes)"
+            if multiple > 1 else "positive"
         )
+        raise ValueError(f"{name}={val} must be {kind}")
     return val
 
 
 CHUNK = _env_block("FAST_TFFM_K1_CHUNK", 512)
 TILE = _env_block("FAST_TFFM_K2_TILE", 256)
+# Subtiles processed per K2/K-place grid step.  On real v5e the first
+# hardware sweep showed per-grid-step overhead (~2-3us: DMA latency not
+# overlapped, step bookkeeping) dominating the apply at V/TILE = 16k
+# steps; grouping G subtiles per step with double-buffered window DMAs
+# divides that overhead by G while keeping the placement matmul at the
+# MXU-optimal [TILE, TILE] shape.  Any positive count works (it is a
+# loop trip count, not a tiled dimension); VMEM for the table blocks
+# grows linearly with it.
+GROUP = _env_block("FAST_TFFM_K2_GROUP", 8, multiple=1)
 
 
 def ftrl_solve(z, n, lr, l1, l2, beta):
@@ -193,13 +204,13 @@ def _k1_dedup(payload, upos, starts, firsts, ends, n_out):
 # ---------------------------------------------------------------- K2: apply
 
 
-def _placed_sums(u_vmem, cnt, d, tile):
+def _placed_sums(u, cnt, d, tile):
     """Window entries -> dense per-row sums [R, D] x2 via one-hot matmul."""
     e_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
     mask = e_iota < cnt  # [R, 1] valid-entry mask
     # The window tail belongs to later tiles (or is uninitialized); zero it
     # with where() — a multiply would keep NaN garbage (NaN*0 == NaN).
-    u = jnp.where(mask, u_vmem[...], 0.0)  # [R, L]
+    u = jnp.where(mask, u, 0.0)  # [R, L]
     # Tile-local row as int32 for the iota compare: tpu.iota is
     # integer-only (a f32 iota fails Mosaic verification).  The f32 value
     # is exact for any TILE < 2^24 (f32 integers are exact below that),
@@ -217,76 +228,88 @@ def _placed_sums(u_vmem, cnt, d, tile):
     return dense[:, :d], dense[:, d:2 * d]  # sum(g), sum(g^2) per row
 
 
-def _k2_adagrad_kernel(tile_start_ref, table_ref, acc_ref, u_hbm_ref,
-                       table_out_ref, acc_out_ref, u_vmem, sem,
-                       *, tile, d, lr, eps):
-    t = pl.program_id(0)
-    start = tile_start_ref[t]
-    cnt = tile_start_ref[t + 1] - start
-    cp = pltpu.make_async_copy(u_hbm_ref.at[pl.ds(start, tile)], u_vmem, sem)
-    cp.start()
-    cp.wait()
-    g1, g2 = _placed_sums(u_vmem, cnt, d, tile)
-    acc_new = acc_ref[...] + g2
-    table_out_ref[...] = table_ref[...] - lr * g1 * jax.lax.rsqrt(
-        acc_new + eps
+def _group_for(n_tiles: int) -> int:
+    """Largest group <= GROUP that divides the tile count."""
+    group = max(1, min(GROUP, n_tiles))
+    while n_tiles % group:
+        group -= 1
+    return group
+
+
+def _window_loop(ts_ref, u_hbm_ref, u_vmem, sem, *, tile, group, d, body):
+    """Double-buffered subtile loop shared by K2 and K-place.
+
+    Walks ``group`` subtiles, DMA-ing each one's entry window while the
+    previous subtile's placement matmul runs (subtile j+1's copy is in
+    flight during subtile j's compute), and calls ``body(j, g1, g2)``
+    with the placed per-row sums.  This is the one copy of the
+    slot/semaphore rotation protocol — keep it that way.
+    """
+    base = pl.program_id(0) * group
+
+    def window(j, slot):
+        start = ts_ref[base + j]
+        return pltpu.make_async_copy(
+            u_hbm_ref.at[pl.ds(start, tile)], u_vmem.at[slot], sem.at[slot]
+        )
+
+    window(0, 0).start()
+    for j in range(group):  # unrolled: all slices static
+        slot = j % 2
+        if j + 1 < group:
+            window(j + 1, (j + 1) % 2).start()
+        window(j, slot).wait()
+        cnt = ts_ref[base + j + 1] - ts_ref[base + j]
+        g1, g2 = _placed_sums(u_vmem[slot], cnt, d, tile)
+        body(j, g1, g2)
+
+
+def _k2_group_kernel(ts_ref, *args, n_tables, tile, group, d, update):
+    """Generic K2 body: a group of subtiles per grid step.
+
+    ``update(g1, g2, *table_slices) -> new_table_slices`` is one of the
+    shared elementwise optimizer formulas (adagrad_update/...).
+    """
+    ins = args[:n_tables]
+    u_hbm_ref = args[n_tables]
+    outs = args[n_tables + 1:2 * n_tables + 1]
+    u_vmem, sem = args[2 * n_tables + 1:]
+
+    def body(j, g1, g2):
+        rows = pl.ds(j * tile, tile)
+        new = update(g1, g2, *(r[rows, :] for r in ins))
+        for out_ref, val in zip(outs, new):
+            out_ref[rows, :] = val
+
+    _window_loop(
+        ts_ref, u_hbm_ref, u_vmem, sem, tile=tile, group=group, d=d,
+        body=body,
     )
-    acc_out_ref[...] = acc_new
 
 
-def _k2_sgd_kernel(tile_start_ref, table_ref, u_hbm_ref, table_out_ref,
-                   u_vmem, sem, *, tile, d, lr):
-    t = pl.program_id(0)
-    start = tile_start_ref[t]
-    cnt = tile_start_ref[t + 1] - start
-    cp = pltpu.make_async_copy(u_hbm_ref.at[pl.ds(start, tile)], u_vmem, sem)
-    cp.start()
-    cp.wait()
-    g1, _ = _placed_sums(u_vmem, cnt, d, tile)
-    table_out_ref[...] = table_ref[...] - lr * g1
-
-
-def _k2_ftrl_kernel(tile_start_ref, table_ref, z_ref, n_ref, u_hbm_ref,
-                    table_out_ref, z_out_ref, n_out_ref, u_vmem, sem,
-                    *, tile, d, lr, l1, l2, beta):
-    t = pl.program_id(0)
-    start = tile_start_ref[t]
-    cnt = tile_start_ref[t + 1] - start
-    cp = pltpu.make_async_copy(u_hbm_ref.at[pl.ds(start, tile)], u_vmem, sem)
-    cp.start()
-    cp.wait()
-    g1, g2 = _placed_sums(u_vmem, cnt, d, tile)
-    n_old = n_ref[...]
-    w_old = table_ref[...]
-    n_new = n_old + g2
-    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n_old)) / lr
-    z_new = z_ref[...] + g1 - sigma * w_old
-    # Recomputing w for untouched rows is idempotent: their (z, n) are
-    # unchanged and w is always ftrl_solve(z, n) (train.sparse initializes
-    # z so this holds from step 0).
-    table_out_ref[...] = ftrl_solve(z_new, n_new, lr, l1, l2, beta)
-    z_out_ref[...] = z_new
-    n_out_ref[...] = n_new
-
-
-def _k2_call(kernel, tile_start, u, tables, lanes):
-    """Run a K2 variant streaming ``tables`` (tuple) tile-by-tile."""
+def _k2_call(update, tile_start, u, tables, lanes):
+    """Stream ``tables`` (tuple) through the grouped K2 apply kernel."""
     v, d = tables[0].shape
     tile = TILE
+    group = _group_for(v // tile)
     n_arrays = len(tables)
+    block = tile * group
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(v // tile,),
-        in_specs=[pl.BlockSpec((tile, d), lambda t, *_: (t, 0))] * n_arrays
+        grid=(v // block,),
+        in_specs=[pl.BlockSpec((block, d), lambda t, *_: (t, 0))] * n_arrays
         + [pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=[pl.BlockSpec((tile, d), lambda t, *_: (t, 0))] * n_arrays,
+        out_specs=[pl.BlockSpec((block, d), lambda t, *_: (t, 0))] * n_arrays,
         scratch_shapes=[
-            pltpu.VMEM((tile, lanes), jnp.float32),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, tile, lanes), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     return pl.pallas_call(
-        kernel,
+        functools.partial(
+            _k2_group_kernel, n_tables=n_arrays, tile=tile, group=group,
+            d=d, update=update,
+        ),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((v, d), jnp.float32) for _ in range(n_arrays)
@@ -299,33 +322,37 @@ def _k2_call(kernel, tile_start, u, tables, lanes):
 # ------------------------------------------------- K-place: dense expansion
 
 
-def _kplace_kernel(tile_start_ref, u_hbm_ref, out_ref, u_vmem, sem,
-                   *, tile, d):
-    """Expand the unique-entry stream into a dense [R, 2D] delta block."""
-    t = pl.program_id(0)
-    start = tile_start_ref[t]
-    cnt = tile_start_ref[t + 1] - start
-    cp = pltpu.make_async_copy(u_hbm_ref.at[pl.ds(start, tile)], u_vmem, sem)
-    cp.start()
-    cp.wait()
-    g1, g2 = _placed_sums(u_vmem, cnt, d, tile)
-    out_ref[...] = jnp.concatenate([g1, g2], axis=-1)
+def _kplace_kernel(ts_ref, u_hbm_ref, out_ref, u_vmem, sem,
+                   *, tile, group, d):
+    """Expand the unique-entry stream into dense [R, 2D] delta blocks."""
+
+    def body(j, g1, g2):
+        out_ref[pl.ds(j * tile, tile), :] = jnp.concatenate(
+            [g1, g2], axis=-1
+        )
+
+    _window_loop(
+        ts_ref, u_hbm_ref, u_vmem, sem, tile=tile, group=group, d=d,
+        body=body,
+    )
 
 
 def _kplace_call(tile_start, u, vocab_local, d, lanes):
     tile = TILE
+    group = _group_for(vocab_local // tile)
+    block = tile * group
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(vocab_local // tile,),
+        grid=(vocab_local // block,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec((tile, 2 * d), lambda t, *_: (t, 0)),
+        out_specs=pl.BlockSpec((block, 2 * d), lambda t, *_: (t, 0)),
         scratch_shapes=[
-            pltpu.VMEM((tile, lanes), jnp.float32),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, tile, lanes), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     return pl.pallas_call(
-        functools.partial(_kplace_kernel, tile=tile, d=d),
+        functools.partial(_kplace_kernel, tile=tile, group=group, d=d),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((vocab_local, 2 * d), jnp.float32),
         interpret=_use_interpret(),
@@ -424,28 +451,27 @@ def adagrad_apply(table, acc, ids, g_rows, *, lr, eps):
     """Sparse Adagrad over touched rows: exact SparseApplyAdagrad semantics."""
     vocab, d = table.shape
     u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
-    kernel = functools.partial(
-        _k2_adagrad_kernel, tile=TILE, d=d, lr=lr, eps=eps
-    )
-    table, acc = _k2_call(kernel, tile_start, u, (table, acc), u.shape[1])
+    update = functools.partial(adagrad_update, lr=lr, eps=eps)
+    table, acc = _k2_call(update, tile_start, u, (table, acc), u.shape[1])
     return table, acc
 
 
 def sgd_apply(table, ids, g_rows, *, lr):
     vocab, d = table.shape
     u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
-    kernel = functools.partial(_k2_sgd_kernel, tile=TILE, d=d, lr=lr)
-    (table,) = _k2_call(kernel, tile_start, u, (table,), u.shape[1])
+    update = functools.partial(sgd_update, lr=lr)
+    (table,) = _k2_call(update, tile_start, u, (table,), u.shape[1])
     return table
 
 
 def ftrl_apply(table, z, n, ids, g_rows, *, lr, l1, l2, beta):
+    # Recomputing w for untouched rows inside ftrl_update is idempotent:
+    # their (z, n) are unchanged and w is always ftrl_solve(z, n)
+    # (train.sparse initializes z so this holds from step 0).
     vocab, d = table.shape
     u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
-    kernel = functools.partial(
-        _k2_ftrl_kernel, tile=TILE, d=d, lr=lr, l1=l1, l2=l2, beta=beta
-    )
-    table, z, n = _k2_call(kernel, tile_start, u, (table, z, n), u.shape[1])
+    update = functools.partial(ftrl_update, lr=lr, l1=l1, l2=l2, beta=beta)
+    table, z, n = _k2_call(update, tile_start, u, (table, z, n), u.shape[1])
     return table, z, n
 
 

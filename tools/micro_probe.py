@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""On-chip micro-experiments behind the step-time hot spots.
+
+The first v5e run (TPU_RESULTS.md) showed three XLA-side costs dwarfing
+the kernels: the 640k-row table gather (16.8 ms), the id sort (10.8 ms)
+and a length-640k cumsum (4.7 ms).  Each experiment here isolates one
+design question for those:
+
+  gather:  does row width (burst size) or index sortedness change the
+           achieved row rate?  Decides whether packing the table to
+           128-lane rows is worth plumbing through the framework.
+  cumsum:  XLA lowers 1-D cumsum to log-depth passes; a blocked
+           [rows, 128] reformulation (cumsum inside lanes via matmul
+           with a triangular matrix + row-offset broadcast) keeps it
+           MXU/VPU-shaped.  Decides how _prep should compute upos.
+  sort:    cost vs N and vs key width (the sharded path sorts N/shards
+           per device; 32- vs 64-bit keys tests packing id+perm into
+           one key as an alternative to sort_key_val).
+
+Timing matches tools/tpu_validate.py: scalar readback drains.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def drain(tree) -> None:
+    import jax
+
+    for leaf in jax.tree.leaves(tree):
+        np.asarray(jax.device_get(leaf.reshape(-1)[:1]))
+
+
+def bench(fn, *args, steps=20):
+    for _ in range(2):
+        drain(fn(*args))
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(steps):
+        r = fn(*args)
+    drain(r)
+    return (time.perf_counter() - t0) * 1e3 / steps
+
+
+def main() -> int:
+    import jax
+
+    # The packed-key sort experiment needs real int64: without x64 JAX
+    # silently downcasts to int32 and (id << 20) wraps for id >= 2^12,
+    # timing a 32-bit sort of garbage keys.
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    V, N = 1 << 22, 16384 * 39
+
+    # ---- gather: row width x index sortedness ------------------------
+    ids_np = rng.integers(0, V, (N,)).astype(np.int32)
+    ids = jax.device_put(jnp.asarray(ids_np))
+    ids_sorted = jax.device_put(jnp.asarray(np.sort(ids_np)))
+    gather = jax.jit(lambda tb, i: tb[i])
+    for d in (9, 16, 32, 64, 128):
+        tb = jax.device_put(
+            jnp.asarray(rng.uniform(-1, 1, (V, d)), jnp.float32))
+        ms_r = bench(gather, tb, ids)
+        ms_s = bench(gather, tb, ids_sorted)
+        rate = N / (ms_r * 1e-3) / 1e6
+        print(
+            f"  gather [{V},{d:3d}] x {N}: random {ms_r:7.3f} ms "
+            f"({rate:5.1f}M rows/s)  sorted {ms_s:7.3f} ms", flush=True)
+        del tb
+
+    # one-hot matmul gather at 128 width for contrast (tile-streamed
+    # idea lower bound, measured as pure XLA): skipped, O(N*V) infeasible.
+
+    # ---- scatter-add: same axes --------------------------------------
+    for d in (9, 128):
+        tb = jax.device_put(jnp.zeros((V, d), jnp.float32))
+        g = jax.device_put(
+            jnp.asarray(rng.uniform(-1, 1, (N, d)), jnp.float32))
+        sc = jax.jit(lambda tb, i, g: tb.at[i].add(g))
+        ms_r = bench(sc, tb, ids, g)
+        ms_s = bench(sc, tb, ids_sorted, g)
+        print(
+            f"  scatter-add [{V},{d:3d}]: random {ms_r:7.3f} ms  "
+            f"sorted {ms_s:7.3f} ms", flush=True)
+        del tb, g
+
+    # ---- cumsum variants ---------------------------------------------
+    flags = jax.device_put(
+        jnp.asarray(rng.integers(0, 2, (N,)), jnp.int32))
+    t_plain = bench(jax.jit(lambda f: jnp.cumsum(f)), flags)
+    t_assoc = bench(
+        jax.jit(lambda f: jax.lax.associative_scan(jnp.add, f)), flags)
+
+    def cumsum_blocked(f):
+        # [N] -> [rows, 128]; within-row prefix via triangular matmul,
+        # across-row offsets via a tiny second cumsum on row sums.
+        rows = f.shape[0] // 128
+        m = f.reshape(rows, 128).astype(jnp.float32)
+        tri = jnp.tril(jnp.ones((128, 128), jnp.float32))
+        within = jax.lax.dot_general(
+            m, tri, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        row_tot = within[:, -1]
+        offs = jnp.cumsum(row_tot) - row_tot
+        return (within + offs[:, None]).reshape(-1).astype(jnp.int32)
+
+    t_block = bench(jax.jit(cumsum_blocked), flags)
+    ref = np.cumsum(np.asarray(flags))
+    got = np.asarray(jax.jit(cumsum_blocked)(flags))
+    ok = bool((ref == got).all())
+    print(
+        f"  cumsum[{N}]: plain {t_plain:6.3f} ms  assoc {t_assoc:6.3f} ms"
+        f"  blocked-matmul {t_block:6.3f} ms (exact={ok})", flush=True)
+
+    # ---- sort scaling -------------------------------------------------
+    iota = jnp.arange(N, dtype=jnp.int32)
+    for n in (N // 8, N // 2, N):
+        sub = ids[:n]
+        t_kv = bench(
+            jax.jit(lambda i: jax.lax.sort_key_val(i, iota[: i.shape[0]])),
+            sub)
+        packed = (sub.astype(jnp.int64) << 20) | iota[:n].astype(jnp.int64)
+        t_pk = bench(jax.jit(lambda p: jnp.sort(p)), packed)
+        t_1 = bench(jax.jit(lambda i: jnp.sort(i)), sub)
+        print(
+            f"  sort n={n:7d}: key_val(i32,i32) {t_kv:7.3f} ms   "
+            f"packed-i64 {t_pk:7.3f} ms   keys-only {t_1:7.3f} ms",
+            flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

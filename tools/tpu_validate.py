@@ -59,9 +59,10 @@ def main() -> int:
     )
     ap.add_argument(
         "--sweep-blocks", action="store_true",
-        help="time K1/K2 across CHUNK/TILE sizes (grid-overhead vs MXU "
-        "tradeoff is hardware-dependent; sweep on the chip, then pin "
-        "winners via FAST_TFFM_K1_CHUNK / FAST_TFFM_K2_TILE)",
+        help="time K1/K2 across CHUNK/TILE/GROUP sizes (grid-overhead vs "
+        "MXU tradeoff is hardware-dependent; sweep on the chip, then pin "
+        "winners via FAST_TFFM_K1_CHUNK / FAST_TFFM_K2_TILE / "
+        "FAST_TFFM_K2_GROUP)",
     )
     args = ap.parse_args()
 
@@ -213,6 +214,7 @@ def main() -> int:
                 emit(f"  {label}: FAILED {type(exc).__name__}: "
                      f"{str(exc).splitlines()[0][:150]}")
 
+        orig_group = sparse_apply.GROUP
         try:
             for chunk in (256, 512, 1024, 2048):
                 sparse_apply.CHUNK = chunk
@@ -223,8 +225,16 @@ def main() -> int:
                     continue
                 sparse_apply.TILE = tile
                 try_candidate(f"K2 TILE={tile:6d} (CHUNK={orig_chunk})")
+            sparse_apply.TILE = orig_tile
+            for group in (1, 4, 8, 16, 32):
+                sparse_apply.GROUP = group
+                try_candidate(
+                    f"K2 GROUP={group:5d} (TILE={orig_tile})"
+                )
         finally:
-            sparse_apply.CHUNK, sparse_apply.TILE = orig_chunk, orig_tile
+            sparse_apply.CHUNK = orig_chunk
+            sparse_apply.TILE = orig_tile
+            sparse_apply.GROUP = orig_group
 
     # ---- 3. full steps -------------------------------------------------
     import shutil
@@ -285,13 +295,14 @@ def main() -> int:
 
     if args.out:
         flags = "".join(
-            f" --{name}" for name in ("quick", "smoke")
+            f" --{name.replace('_', '-')}" for name in
+            ("quick", "smoke", "sweep_blocks")
             if getattr(args, name)
         )
         header = [
             "# TPU validation results",
             "",
-            f"`python tools/tpu_validate.py{flags}`"
+            f"`python tools/tpu_validate.py{flags} --out {args.out}`"
             f" — B={B}, F={F}, k={K}, vocab=2^{V.bit_length() - 1}.",
             "",
             "```",
