@@ -24,6 +24,13 @@ init poisons jax's backend cache); if the tunnel never comes up the bench
 falls back to CPU with an ``error`` note — the JSON line is emitted either
 way so the driver always gets a parseable record.
 
+The whole measured run itself also executes in a watchdog SUBPROCESS:
+a tunnel that dies MID-bench leaves the client blocked in an RPC that no
+exception ever escapes (observed on v5e: probe OK at start, pool gone
+minutes later, main process asleep forever).  The parent kills the child
+at a hard deadline and re-runs on CPU, recording the reason — hangs, not
+just errors, can no longer zero a hardware window.
+
 Timing note: completion is forced by reading back scalars that depend on
 both the metrics chain and the updated table.  ``block_until_ready`` alone
 under-reports on remote-tunnel platforms (it can return before the queued
@@ -78,6 +85,46 @@ def _probe_backend(attempts: int = 3, timeout: int = 240):
         if i + 1 < attempts:
             time.sleep(5 * (i + 1))
     return None, 0, f"backend unavailable after {attempts} probes: {last_err}"
+
+
+# Hard deadline for the watchdog child (seconds).  A healthy TPU run is
+# ~3-6 min (a handful of ~40s tunnel compiles + the measured steps); a
+# wedged tunnel blocks forever.  Overridable for tests.
+WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "1800"))
+
+
+def _run_watchdog_child(argv: list[str]):
+    """Run the full bench in a killable child; return (json_line, reason).
+
+    ``json_line`` is the child's result line (None if it hung, died, or
+    printed no JSON), ``reason`` explains the failure for the fallback
+    run's error note.
+    """
+    env = dict(os.environ, BENCH_CHILD="1")
+    cmd = [sys.executable, os.path.abspath(__file__)] + argv
+    try:
+        # stderr inherits the parent's: progress/probe/traceback lines
+        # stream live instead of vanishing into a pipe (the JSON contract
+        # only covers stdout, which is captured and filtered).
+        out = subprocess.run(
+            cmd, env=env, stdout=subprocess.PIPE, text=True,
+            timeout=WATCHDOG_S,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"tpu bench hung; watchdog killed it after {WATCHDOG_S}s"
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+                return line, None
+            except ValueError:
+                continue
+    tail = out.stdout.strip().splitlines()
+    note = tail[-1][-200:] if tail else "no stdout (traceback on stderr)"
+    return None, (
+        f"bench child exited {out.returncode} without a JSON line: {note}"
+    )
 
 
 def _zipf_ids(rng, shape, vocab: int) -> np.ndarray:
@@ -250,8 +297,21 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=50)
     args = ap.parse_args()
 
+    watchdog_note = None
+    if not os.environ.get("BENCH_CHILD") and not os.environ.get(
+        "BENCH_FORCE_CPU"
+    ):
+        # Parent role: delegate the real run to a killable child; fall
+        # through to an in-process CPU run only if the child hangs/dies.
+        line, reason = _run_watchdog_child(sys.argv[1:])
+        if line is not None:
+            print(line)
+            return 0
+        os.environ["BENCH_FORCE_CPU"] = "1"
+        watchdog_note = reason
+
     if os.environ.get("BENCH_FORCE_CPU"):
-        platform, n_chips, err = None, 0, None
+        platform, n_chips, err = None, 0, watchdog_note
     else:
         platform, n_chips, err = _probe_backend()
     if platform is None or platform == "cpu":

@@ -127,3 +127,44 @@ def test_ladder_real_trainer_injected_step_failure(tmp_path):
     assert rung == "scatter"
     assert trainer is not None
     assert any("Pallas TPU lowering" in e for e in errors)
+
+
+def test_watchdog_kills_hung_child_and_reports(tmp_path, monkeypatch):
+    """A child that never returns (mid-run tunnel death) must be killed at
+    the deadline, not waited on forever; the reason reaches the caller."""
+    monkeypatch.setattr(bench, "WATCHDOG_S", 1)
+    # Point the child at a script that sleeps past the deadline.
+    hang = tmp_path / "hang.py"
+    hang.write_text("import time; time.sleep(60)\n")
+    monkeypatch.setattr(bench.os.path, "abspath", lambda _: str(hang))
+    line, reason = bench._run_watchdog_child([])
+    assert line is None
+    assert "watchdog killed" in reason
+
+
+def test_watchdog_returns_child_json(tmp_path, monkeypatch):
+    """The parent must forward exactly the child's JSON result line."""
+    child = tmp_path / "ok.py"
+    child.write_text(
+        "print('noise')\nprint('{\"value\": 42}')\nprint('done')\n"
+    )
+    monkeypatch.setattr(bench.os.path, "abspath", lambda _: str(child))
+    line, reason = bench._run_watchdog_child([])
+    assert reason is None
+    assert bench.json.loads(line) == {"value": 42}
+
+
+def test_watchdog_reports_json_less_child(tmp_path, monkeypatch):
+    """A child that dies before printing JSON yields a reason, not a hang.
+
+    Its stderr is NOT captured (it streams through live for diagnosis);
+    the reason is built from the stdout tail only."""
+    child = tmp_path / "die.py"
+    child.write_text(
+        "import sys; print('partial progress'); "
+        "print('crash', file=sys.stderr); sys.exit(3)\n"
+    )
+    monkeypatch.setattr(bench.os.path, "abspath", lambda _: str(child))
+    line, reason = bench._run_watchdog_child([])
+    assert line is None
+    assert "exited 3" in reason and "partial progress" in reason

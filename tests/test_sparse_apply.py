@@ -133,14 +133,23 @@ def test_adagrad_multi_step_training_converges():
     assert after < before - 0.1, (before, after)
 
 
-@pytest.mark.parametrize("chunk,tile", [(256, 512), (1024, 256)])
-def test_adagrad_matches_scatter_alternate_blocks(chunk, tile):
-    """The tunable CHUNK/TILE candidates must stay numerically exact,
-    not just compile: the hardware sweep would otherwise crown a
+@pytest.mark.parametrize(
+    "chunk,tile,group",
+    [
+        (256, 512, 1),   # ungrouped K2: one window per grid step
+        (1024, 256, 2),  # minimal double-buffer rotation
+        (256, 128, 16),  # large unrolled loop (16 of V/128 = 16 tiles)
+    ],
+)
+def test_adagrad_matches_scatter_alternate_blocks(chunk, tile, group):
+    """The tunable CHUNK/TILE/GROUP candidates must stay numerically
+    exact, not just compile: the hardware sweep would otherwise crown a
     fast-but-wrong block size.  Hot ids span multiple chunks at both
     chunk sizes."""
-    orig = sparse_apply.CHUNK, sparse_apply.TILE
-    sparse_apply.CHUNK, sparse_apply.TILE = chunk, tile
+    orig = sparse_apply.CHUNK, sparse_apply.TILE, sparse_apply.GROUP
+    sparse_apply.CHUNK = chunk
+    sparse_apply.TILE = tile
+    sparse_apply.GROUP = group
     try:
         # n leaves plenty of non-hot ids at both chunk sizes: the hot
         # run spans 2+ chunks AND chunks still mix distinct ids (an
@@ -161,7 +170,50 @@ def test_adagrad_matches_scatter_alternate_blocks(chunk, tile):
         np.testing.assert_allclose(t_tile, t_ref, rtol=2e-5, atol=5e-6)
         np.testing.assert_allclose(a_tile, a_ref, rtol=2e-5, atol=5e-6)
     finally:
-        sparse_apply.CHUNK, sparse_apply.TILE = orig
+        sparse_apply.CHUNK, sparse_apply.TILE, sparse_apply.GROUP = orig
+
+
+def test_adagrad_exact_at_odd_group():
+    """Odd group sizes end the unrolled loop on the opposite buffer slot;
+    the slot/semaphore rotation must still line up.  Needs a non-power-
+    of-two tile count (1536/256 = 6, group 3)."""
+    v = 1536
+    orig = sparse_apply.GROUP
+    sparse_apply.GROUP = 3
+    try:
+        rng = np.random.default_rng(11)
+        ids = jnp.asarray(rng.integers(0, v, (1200,)), jnp.int32)
+        g = jnp.asarray(rng.uniform(-1, 1, (1200, D)), jnp.float32)
+        table = jnp.asarray(rng.uniform(-1, 1, (v, D)), jnp.float32)
+        acc = jnp.full((v, D), 0.1, jnp.float32)
+        t_tile, a_tile = sparse_apply.adagrad_apply(
+            table, acc, ids, g, lr=0.1, eps=1e-7
+        )
+        a_ref = acc.at[ids].add(g * g)
+        t_ref = table.at[ids].add(
+            -0.1 * g * jax.lax.rsqrt(a_ref[ids] + 1e-7)
+        )
+        np.testing.assert_allclose(t_tile, t_ref, rtol=2e-5, atol=5e-6)
+        np.testing.assert_allclose(a_tile, a_ref, rtol=2e-5, atol=5e-6)
+    finally:
+        sparse_apply.GROUP = orig
+
+
+def test_group_for_clamps_to_divisor():
+    """GROUP is a preference; the kernel needs a divisor of the tile
+    count (and at least 1)."""
+    orig = sparse_apply.GROUP
+    try:
+        sparse_apply.GROUP = 16
+        assert sparse_apply._group_for(8) == 8    # clamp to n_tiles
+        assert sparse_apply._group_for(12) == 12  # 16>12 -> clamp, divides
+        assert sparse_apply._group_for(48) == 16  # fits and divides
+        sparse_apply.GROUP = 5
+        assert sparse_apply._group_for(8) == 4    # 5 does not divide 8
+        sparse_apply.GROUP = 7
+        assert sparse_apply._group_for(13) == 1   # prime tile count
+    finally:
+        sparse_apply.GROUP = orig
 
 
 def test_supports_tile_gating():

@@ -24,29 +24,13 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-
-def drain(tree) -> None:
-    import jax
-
-    for leaf in jax.tree.leaves(tree):
-        np.asarray(jax.device_get(leaf.reshape(-1)[:1]))
-
-
-def bench(fn, *args, steps=20):
-    for _ in range(2):
-        drain(fn(*args))
-    t0 = time.perf_counter()
-    r = None
-    for _ in range(steps):
-        r = fn(*args)
-    drain(r)
-    return (time.perf_counter() - t0) * 1e3 / steps
+from timing import bench, drain  # noqa: E402
 
 
 def main() -> int:
@@ -106,7 +90,9 @@ def main() -> int:
         # across-row offsets via a tiny second cumsum on row sums.
         rows = f.shape[0] // 128
         m = f.reshape(rows, 128).astype(jnp.float32)
-        tri = jnp.tril(jnp.ones((128, 128), jnp.float32))
+        # within[r, c] = sum_{k<=c} m[r, k] needs tri[k, c] = (k <= c),
+        # i.e. upper-triangular (tril would give suffix sums).
+        tri = jnp.triu(jnp.ones((128, 128), jnp.float32))
         within = jax.lax.dot_general(
             m, tri, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

@@ -25,24 +25,9 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-
-def drain(tree) -> None:
-    import jax
-
-    for leaf in jax.tree.leaves(tree):
-        np.asarray(jax.device_get(leaf.reshape(-1)[:1] if hasattr(leaf, "reshape") else leaf))
-
-
-def bench(fn, *args, steps=20):
-    for _ in range(2):
-        drain(fn(*args))
-    t0 = time.perf_counter()
-    r = None
-    for _ in range(steps):
-        r = fn(*args)
-    drain(r)
-    return (time.perf_counter() - t0) * 1e3 / steps
+from timing import bench, drain  # noqa: E402
 
 
 def main() -> int:
