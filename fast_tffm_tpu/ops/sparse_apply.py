@@ -75,6 +75,12 @@ TILE = _env_block("FAST_TFFM_K2_TILE", 256)
 # loop trip count, not a tiled dimension); VMEM for the table blocks
 # grows linearly with it.
 GROUP = _env_block("FAST_TFFM_K2_GROUP", 8, multiple=1)
+# Chunks per K1 grid step.  Same grid-overhead motivation, but K1's
+# grouping IS a tiled dimension (the payload input block becomes
+# [CHUNK*K1_GROUP, lanes], so pipelined VMEM grows with it), and its
+# output DMA pipelines differently (one in-flight copy, ordered: see
+# _k1_kernel) — hence a knob independent of the K2 one.
+K1_GROUP = _env_block("FAST_TFFM_K1_GROUP", 8, multiple=1)
 
 
 def ftrl_solve(z, n, lr, l1, l2, beta):
@@ -124,67 +130,92 @@ def supports_tile(vocab: int, optimizer: str) -> bool:
 
 
 def _k1_kernel(starts_ref, firsts_ref, ends_ref, payload_ref, upos_ref,
-               out_ref, u_vmem, carry_ref, sem, *, chunk, lanes):
-    j = pl.program_id(0)
-    upos_s = starts_ref[j]
-    payload = payload_ref[...]  # [C, L] f32
-    l = upos_ref[...] - upos_s  # [1, C] local segment index, in [0, C)
-    # onehotT[s, i] = (l[i] == s): segment s on sublanes, occurrence i on
-    # lanes — built directly in the orientation the matmul wants.
-    s_iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
-    oh = (jnp.broadcast_to(l, (chunk, chunk)) == s_iota).astype(jnp.bfloat16)
-    # Segment-sum on the MXU.  f32 payload exactness via bf16 hi/lo split:
-    # hi rounds to bf16, lo carries the residual; both accumulate in f32.
-    p_hi = payload.astype(jnp.bfloat16)
-    p_lo = (payload - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    u_local = (
-        jax.lax.dot(oh, p_hi, preferred_element_type=jnp.float32)
-        + jax.lax.dot(oh, p_lo, preferred_element_type=jnp.float32)
-    )  # [C, L]
-    # Segment spanning in from the previous chunk: add its partial sums to
-    # row 0 via an iota mask — `.at[0:1].add` would emit a scatter-add HLO,
-    # which Mosaic has no TPU lowering for (it aborted the round-3 bench).
-    continues = (firsts_ref[j] == 0) & (j > 0)
-    row0 = jax.lax.broadcasted_iota(jnp.int32, (chunk, lanes), 0) == 0
-    u_local = u_local + jnp.where(
-        row0 & continues,
-        jnp.broadcast_to(carry_ref[0:1, :], (chunk, lanes)),
-        0.0,
-    )
-    # Segment spanning out into the next chunk: move it to the carry and
-    # write a zero — the chunk holding the segment's last occurrence is the
-    # last writer of that row and will hold the complete sum.  Row l_last is
-    # selected with an iota mask: value-level dynamic_slice /
-    # dynamic_update_slice have no Mosaic lowering either (same class as
-    # the scatter-add above).
-    l_last = ends_ref[j] - upos_s
-    cont_next = firsts_ref[j + 1] == 0
-    r_iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, lanes), 0)
-    is_last = r_iota == l_last
-    last_row = jnp.sum(
-        jnp.where(is_last, u_local, 0.0), axis=0, keepdims=True
-    )  # [1, lanes] == u_local[l_last]
-    carry_ref[...] = jnp.broadcast_to(
-        jnp.where(cont_next, last_row, 0.0), (8, lanes)
-    )
-    # If the segment continues, zero its row here; otherwise leave it (the
-    # reference code wrote last_row back to its own row — a no-op).
-    u_local = jnp.where(is_last & cont_next, 0.0, u_local)
-    u_vmem[...] = u_local
-    cp = pltpu.make_async_copy(u_vmem, out_ref.at[pl.ds(upos_s, chunk)], sem)
-    cp.start()
-    cp.wait()
+               out_ref, u_vmem, carry_ref, sem, *, chunk, group, lanes):
+    t = pl.program_id(0)
+    prev_cp = None  # the single in-flight output copy
+    for j in range(group):  # unrolled: all slices static
+        cj = t * group + j  # global chunk index (scalar arrays use it)
+        upos_s = starts_ref[cj]
+        rows = pl.ds(j * chunk, chunk)
+        payload = payload_ref[rows, :]  # [C, L] f32
+        # [1, C] local segment index, in [0, C)
+        l = upos_ref[0:1, pl.ds(j * chunk, chunk)] - upos_s
+        # onehotT[s, i] = (l[i] == s): segment s on sublanes, occurrence
+        # i on lanes — built directly in the orientation the matmul
+        # wants.
+        s_iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
+        oh = (
+            jnp.broadcast_to(l, (chunk, chunk)) == s_iota
+        ).astype(jnp.bfloat16)
+        # Segment-sum on the MXU.  f32 payload exactness via bf16 hi/lo
+        # split: hi rounds to bf16, lo carries the residual; both
+        # accumulate in f32.
+        p_hi = payload.astype(jnp.bfloat16)
+        p_lo = (payload - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        u_local = (
+            jax.lax.dot(oh, p_hi, preferred_element_type=jnp.float32)
+            + jax.lax.dot(oh, p_lo, preferred_element_type=jnp.float32)
+        )  # [C, L]
+        # Segment spanning in from the previous chunk: add its partial
+        # sums to row 0 via an iota mask — `.at[0:1].add` would emit a
+        # scatter-add HLO, which Mosaic has no TPU lowering for (it
+        # aborted the round-3 bench).
+        continues = (firsts_ref[cj] == 0) & (cj > 0)
+        row0 = jax.lax.broadcasted_iota(jnp.int32, (chunk, lanes), 0) == 0
+        u_local = u_local + jnp.where(
+            row0 & continues,
+            jnp.broadcast_to(carry_ref[0:1, :], (chunk, lanes)),
+            0.0,
+        )
+        # Segment spanning out into the next chunk: move it to the carry
+        # and write a zero — the chunk holding the segment's last
+        # occurrence is the last writer of that row and will hold the
+        # complete sum.  Row l_last is selected with an iota mask:
+        # value-level dynamic_slice / dynamic_update_slice have no
+        # Mosaic lowering either (same class as the scatter-add above).
+        l_last = ends_ref[cj] - upos_s
+        cont_next = firsts_ref[cj + 1] == 0
+        r_iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, lanes), 0)
+        is_last = r_iota == l_last
+        last_row = jnp.sum(
+            jnp.where(is_last, u_local, 0.0), axis=0, keepdims=True
+        )  # [1, lanes] == u_local[l_last]
+        carry_ref[...] = jnp.broadcast_to(
+            jnp.where(cont_next, last_row, 0.0), (8, lanes)
+        )
+        # If the segment continues, zero its row here; otherwise leave it
+        # (writing last_row back to its own row would be a no-op).
+        u_local = jnp.where(is_last & cont_next, 0.0, u_local)
+        # Output windows of consecutive chunks OVERLAP whenever a chunk
+        # holds duplicates (upos advances by its unique count < chunk),
+        # and correctness rests on the later chunk's rows landing last —
+        # so at most ONE copy may be in flight.  Waiting for chunk j-1's
+        # copy only HERE (after this chunk's matmul) still hides the DMA
+        # behind the compute; the single buffer is safe to overwrite
+        # because nothing is in flight after the wait.
+        if prev_cp is not None:
+            prev_cp.wait()
+        u_vmem[...] = u_local
+        prev_cp = pltpu.make_async_copy(
+            u_vmem, out_ref.at[pl.ds(upos_s, chunk)], sem
+        )
+        prev_cp.start()
+    # Drain before returning: the next grid step (or pallas epilogue)
+    # must not race the final window's write.
+    prev_cp.wait()
 
 
 def _k1_dedup(payload, upos, starts, firsts, ends, n_out):
     n, lanes = payload.shape
     chunk = CHUNK
+    group = _group_for(n // chunk, K1_GROUP)
+    block = chunk * group
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(n // chunk,),
+        grid=(n // block,),
         in_specs=[
-            pl.BlockSpec((chunk, lanes), lambda j, *_: (j, 0)),
-            pl.BlockSpec((1, chunk), lambda j, *_: (0, j)),
+            pl.BlockSpec((block, lanes), lambda j, *_: (j, 0)),
+            pl.BlockSpec((1, block), lambda j, *_: (0, j)),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
@@ -194,7 +225,9 @@ def _k1_dedup(payload, upos, starts, firsts, ends, n_out):
         ],
     )
     return pl.pallas_call(
-        functools.partial(_k1_kernel, chunk=chunk, lanes=lanes),
+        functools.partial(
+            _k1_kernel, chunk=chunk, group=group, lanes=lanes
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_out, lanes), jnp.float32),
         interpret=_use_interpret(),
@@ -228,9 +261,9 @@ def _placed_sums(u, cnt, d, tile):
     return dense[:, :d], dense[:, d:2 * d]  # sum(g), sum(g^2) per row
 
 
-def _group_for(n_tiles: int) -> int:
-    """Largest group <= GROUP that divides the tile count."""
-    group = max(1, min(GROUP, n_tiles))
+def _group_for(n_tiles: int, want: int | None = None) -> int:
+    """Largest group <= want (default GROUP) dividing the tile count."""
+    group = max(1, min(GROUP if want is None else want, n_tiles))
     while n_tiles % group:
         group -= 1
     return group
@@ -391,6 +424,33 @@ def _tile_starts(sidx, upos, boundaries):
     return upos_ext[ss].astype(jnp.int32)
 
 
+def _cumsum_counts(flags):
+    """Prefix sum of 0/1 flags, MXU-shaped.
+
+    XLA lowers a length-640k 1-D cumsum to log-depth VPU passes in a
+    lane-hostile layout (~4.7 ms measured on v5e — comparable to the
+    whole K1 kernel).  Reshaping to [rows, 128] turns the within-row
+    prefix into one [rows,128]x[128,128] triangular matmul plus a
+    128x-shorter cumsum of row totals.  Exact: counts are integers
+    < 2^24, f32-representable; falls back to jnp.cumsum for shapes the
+    reshape or exactness argument does not cover.
+    """
+    n = flags.shape[0]
+    if n % 128 or n >= 1 << 24:
+        return jnp.cumsum(flags)
+    m = flags.reshape(n // 128, 128).astype(jnp.float32)
+    # within[r, c] = sum_{k<=c} m[r, k] needs tri[k, c] = (k <= c):
+    # upper-triangular (tril would give suffix sums).
+    tri = jnp.triu(jnp.ones((128, 128), jnp.float32))
+    within = jax.lax.dot_general(
+        m, tri, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    row_tot = within[:, -1]
+    offs = jnp.cumsum(row_tot) - row_tot
+    return (within + offs[:, None]).reshape(n).astype(flags.dtype)
+
+
 def _prep(ids, g_rows, vocab):
     """Sort, dedup-position, and chunk-boundary metadata (all XLA)."""
     n = ids.shape[0]
@@ -409,7 +469,7 @@ def _prep(ids, g_rows, vocab):
     g_sorted = g_rows[perm]
     prev = jnp.concatenate([jnp.full((1,), -1, sidx.dtype), sidx[:-1]])
     flags = (sidx != prev).astype(jnp.int32)  # segment starts
-    upos = jnp.cumsum(flags) - 1  # unique-row position per occurrence
+    upos = _cumsum_counts(flags) - 1  # unique-row position per occurrence
     nxt = jnp.concatenate([sidx[1:], jnp.full((1,), -2, sidx.dtype)])
     last = (sidx != nxt).astype(jnp.float32)  # segment ends
     lrow = (sidx % TILE).astype(jnp.float32)  # tile-local row, exact < TILE

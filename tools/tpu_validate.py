@@ -200,6 +200,7 @@ def main() -> int:
                      f"{str(exc).splitlines()[0][:150]}")
 
         orig_group = sparse_apply.GROUP
+        orig_k1_group = sparse_apply.K1_GROUP
         try:
             for chunk in (256, 512, 1024, 2048):
                 sparse_apply.CHUNK = chunk
@@ -216,10 +217,17 @@ def main() -> int:
                 try_candidate(
                     f"K2 GROUP={group:5d} (TILE={orig_tile})"
                 )
+            sparse_apply.GROUP = orig_group
+            for group in (1, 4, 16):
+                sparse_apply.K1_GROUP = group
+                try_candidate(
+                    f"K1 GROUP={group:5d} (CHUNK={orig_chunk})"
+                )
         finally:
             sparse_apply.CHUNK = orig_chunk
             sparse_apply.TILE = orig_tile
             sparse_apply.GROUP = orig_group
+            sparse_apply.K1_GROUP = orig_k1_group
 
     # ---- 3. full steps -------------------------------------------------
     import shutil
