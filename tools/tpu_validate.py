@@ -237,19 +237,25 @@ def main() -> int:
     from fast_tffm_tpu.train.loop import Trainer
 
     combos = [
-        ("scatter", False, "float32"),
-        ("scatter", True, "float32"),
-        ("tile", False, "float32"),
-        ("tile", True, "float32"),
-        ("tile", True, "bfloat16"),  # the fast path's bf16 variant
+        ("scatter", False, "float32", 0),
+        ("scatter", True, "float32", 0),
+        ("tile", False, "float32", 0),
+        ("tile", True, "float32", 0),
+        ("tile", True, "bfloat16", 0),  # the fast path's bf16 variant
+        # Field-aware FM (BASELINE config 5): einsum interaction + the
+        # same sparse apply machinery; a hardware window must prove this
+        # path compiles and runs too, not just plain FM.
+        ("tile", True, "float32", 4),
     ]
-    for mode, use_pallas, dtype in combos:
+    for mode, use_pallas, dtype, field_num in combos:
         cfg = FmConfig(
             vocabulary_size=V, factor_num=K, max_features=F,
             batch_size=B, learning_rate=0.05, log_steps=0,
             sparse_apply=mode, use_pallas=use_pallas,
-            compute_dtype=dtype,
-            model_file=f"/tmp/tpuval_{mode}_{int(use_pallas)}_{dtype}",
+            compute_dtype=dtype, field_num=field_num,
+            model_file=(
+                f"/tmp/tpuval_{mode}_{int(use_pallas)}_{dtype}_{field_num}"
+            ),
         )
         shutil.rmtree(cfg.model_file, ignore_errors=True)
         trainer = Trainer(cfg)
@@ -259,7 +265,10 @@ def main() -> int:
                 labels=rng.integers(0, 2, (B,)).astype(np.float32),
                 ids=rng.integers(0, V, (B, F)).astype(np.int32),
                 vals=rng.uniform(0.1, 1.0, (B, F)).astype(np.float32),
-                fields=np.zeros((B, F), np.int32),
+                fields=(
+                    rng.integers(0, field_num, (B, F)).astype(np.int32)
+                    if field_num else np.zeros((B, F), np.int32)
+                ),
                 weights=np.ones((B,), np.float32),
             )))
 
@@ -281,6 +290,7 @@ def main() -> int:
             "step": (
                 f"sparse_apply={mode} use_pallas={use_pallas} "
                 f"compute_dtype={dtype}"
+                + (f" field_num={field_num}" if field_num else "")
             ),
             "ms_per_step": round(ms, 2),
             "examples_per_sec": round(B * steps / dt, 1),
