@@ -46,6 +46,26 @@ def main() -> int:
     rng = np.random.default_rng(0)
     V, N = 1 << 22, 16384 * 39
 
+    # ---- physical size of narrow-minor-dim HBM buffers ----------------
+    # If XLA tiles [V, 9] f32 to 128 lanes in HBM, the table physically
+    # occupies ~14x its logical bytes and K2's "stream the table" pass
+    # moves ~8.6 GB/step instead of ~600 MB — the deciding fact for a
+    # packed [V/8, 128] storage format.
+    dev = jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if stats:
+        base = stats["bytes_in_use"]
+        tb = jax.device_put(jnp.zeros((V, 9), jnp.float32))
+        tb.block_until_ready()
+        used = dev.memory_stats()["bytes_in_use"] - base
+        logical = V * 9 * 4
+        print(
+            f"  [V,9] f32 table: logical {logical / 1e6:.0f} MB, device "
+            f"{used / 1e6:.0f} MB ({used / logical:.1f}x)", flush=True)
+        del tb
+    else:
+        print("  memory_stats unavailable on this backend", flush=True)
+
     # ---- gather: row width x index sortedness ------------------------
     ids_np = rng.integers(0, V, (N,)).astype(np.int32)
     ids = jax.device_put(jnp.asarray(ids_np))
@@ -61,6 +81,58 @@ def main() -> int:
             f"  gather [{V},{d:3d}] x {N}: random {ms_r:7.3f} ms "
             f"({rate:5.1f}M rows/s)  sorted {ms_s:7.3f} ms", flush=True)
         del tb
+
+    # Packed-layout gather: table as [V/8, 128] super-rows (8 logical
+    # rows x 16-lane slots).  The gather touches V/8-row space at 512-
+    # byte rows; the slot select is VPU work.  Compares the end-to-end
+    # cost of producing the same [N, 16] rows against the [V, 9] gather
+    # above — decides whether packing pays on the lookup side too.
+    packed = jax.device_put(
+        jnp.asarray(rng.uniform(-1, 1, (V // 8, 128)), jnp.float32))
+
+    def packed_gather(tb, i):
+        sup = tb[i >> 3]  # [N, 128]
+        slot = (i & 7).astype(jnp.int32)
+        oh = (slot[:, None] == jnp.arange(8, dtype=jnp.int32)[None, :])
+        sel = jnp.einsum(
+            "ns,nsl->nl", oh.astype(jnp.float32),
+            sup.reshape(-1, 8, 16), precision=jax.lax.Precision.HIGHEST)
+        return sel  # [N, 16]
+
+    pg = jax.jit(packed_gather)
+    ms_r = bench(pg, packed, ids)
+    ms_s = bench(pg, packed, ids_sorted)
+    print(
+        f"  packed-gather [V/8,128]+select: random {ms_r:7.3f} ms  "
+        f"sorted {ms_s:7.3f} ms", flush=True)
+    del packed
+
+    # ---- lane efficiency of [B, F, 9] elementwise chains --------------
+    # fwd/bwd stream [B, F, D] arrays whose minor dim pads 9 -> 128
+    # (7% lane use).  Times one representative op in three layouts.
+    B, F = 16384, 39
+    r3 = jax.device_put(
+        jnp.asarray(rng.uniform(-1, 1, (B, F, 9)), jnp.float32))
+    vals2 = jax.device_put(
+        jnp.asarray(rng.uniform(0.1, 1.0, (B, F)), jnp.float32))
+    t_bfd = bench(
+        jax.jit(lambda r, v: jnp.sum(r * v[..., None], axis=1)), r3, vals2)
+    rflat = jax.device_put(
+        jnp.asarray(rng.uniform(-1, 1, (B, F * 9)), jnp.float32))
+    # Same logical workload as the [B,F,9] variant: vals stay [B, F] and
+    # broadcast per-factor inside the jitted fn (an independent [B,F*9]
+    # vals array would add ~3x the vals HBM traffic and bias the
+    # comparison against the flat layout).
+    t_flat = bench(
+        jax.jit(lambda r, v: jnp.sum(
+            (r * jnp.repeat(v, 9, axis=1)).reshape(-1, F, 9), axis=1)),
+        rflat, vals2)
+    t_flat_nosum = bench(
+        jax.jit(lambda r, v: r * jnp.repeat(v, 9, axis=1)), rflat, vals2)
+    print(
+        f"  elementwise+field-sum: [B,F,9] {t_bfd:6.3f} ms   "
+        f"[B,F*9]->reshape-sum {t_flat:6.3f} ms   "
+        f"[B,F*9] mult-only {t_flat_nosum:6.3f} ms", flush=True)
 
     # one-hot matmul gather at 128 width for contrast (tile-streamed
     # idea lower bound, measured as pure XLA): skipped, O(N*V) infeasible.
