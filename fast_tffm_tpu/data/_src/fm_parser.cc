@@ -448,4 +448,95 @@ int64_t fm_parser_parse_raw(void* handle, const char* buf,
   });
 }
 
+// Host-side sparse-apply prep: stable-sort the batch's flat ids and
+// derive, in one linear scan, every id-only quantity the tile apply
+// kernels need (rebuilding what ops/sparse_apply._prep computes on
+// device).  On v5e the device-side XLA sort alone costs ~10.8 ms/step
+// at Criteo shapes; here it rides the parser's pipeline threads,
+// overlapped with device compute.  Must match the device path exactly:
+// the sort is STABLE (duplicate ids keep occurrence order, like
+// jax.lax.sort_key_val with an iota payload), padded tail slots get
+// sentinel id == vocab (sorted last, landing in no tile since
+// boundaries stop at vocab).
+//
+// In:  ids [n] int32 in [0, vocab); n <= n_pad; n_pad a chunk multiple.
+// Out (caller-allocated):
+//   perm       [n_pad] i32  occurrence index per sorted position
+//   upos       [n_pad] i32  unique-segment index per sorted position
+//   lrow_last  [n_pad] f32  (sidx % tile) if segment end else 0
+//   starts     [n_pad/chunk]     i32  upos at each chunk start
+//   firsts     [n_pad/chunk + 1] i32  segment-start flag at chunk starts
+//                                     (+1 trailing sentinel, always 1)
+//   ends       [n_pad/chunk]     i32  upos at each chunk end
+//   tile_start [vocab/tile + 1]  i32  unique index of first id >= t*tile
+// Returns the number of unique real ids (excluding sentinels), or -1 on
+// bad arguments.
+int64_t fm_sort_meta(const int32_t* ids, int64_t n, int64_t n_pad,
+                     int64_t vocab, int64_t chunk, int64_t tile,
+                     int32_t* perm, int32_t* upos, float* lrow_last,
+                     int32_t* starts, int32_t* firsts, int32_t* ends,
+                     int32_t* tile_start) {
+  if (n < 0 || n > n_pad || n_pad <= 0 || n_pad % chunk || chunk <= 0 ||
+      tile <= 0 || vocab <= 0 || vocab % tile || vocab > INT32_MAX) {
+    return -1;
+  }
+  const int64_t n_chunks = n_pad / chunk;
+  const int64_t n_tiles = vocab / tile;
+  // Stable LSD radix sort of (key=id, payload=index), 4 x 8-bit passes.
+  // Sentinel-padded tail: key == vocab sorts after every real id.
+  std::vector<int32_t> key(n_pad), key2(n_pad), idx(n_pad), idx2(n_pad);
+  for (int64_t i = 0; i < n_pad; ++i) {
+    key[i] = i < n ? ids[i] : static_cast<int32_t>(vocab);
+    idx[i] = static_cast<int32_t>(i);
+  }
+  int32_t* k_src = key.data();
+  int32_t* k_dst = key2.data();
+  int32_t* i_src = idx.data();
+  int32_t* i_dst = idx2.data();
+  for (int shift = 0; shift < 32; shift += 8) {
+    if ((static_cast<uint64_t>(vocab) >> shift) == 0) break;  // keys done
+    int64_t count[257] = {0};
+    for (int64_t i = 0; i < n_pad; ++i) {
+      ++count[((static_cast<uint32_t>(k_src[i]) >> shift) & 0xFF) + 1];
+    }
+    for (int b = 0; b < 256; ++b) count[b + 1] += count[b];
+    for (int64_t i = 0; i < n_pad; ++i) {
+      int64_t pos = count[(static_cast<uint32_t>(k_src[i]) >> shift) & 0xFF]++;
+      k_dst[pos] = k_src[i];
+      i_dst[pos] = i_src[i];
+    }
+    std::swap(k_src, k_dst);
+    std::swap(i_src, i_dst);
+  }
+  // One scan: uniques, chunk metadata, tile boundaries.
+  int64_t nu = 0;        // uniques so far (including sentinels at tail)
+  int64_t nu_real = 0;   // uniques among real ids
+  int64_t t = 0;         // next tile boundary to place (value t * tile)
+  for (int64_t p = 0; p < n_pad; ++p) {
+    const int32_t id = k_src[p];
+    const bool first = (p == 0) || (id != k_src[p - 1]);
+    if (first) {
+      while (t <= n_tiles && t * tile <= id) {
+        tile_start[t++] = static_cast<int32_t>(nu);
+      }
+      ++nu;
+      if (id < vocab) ++nu_real;
+    }
+    perm[p] = i_src[p];
+    upos[p] = static_cast<int32_t>(nu - 1);
+    const bool last = (p + 1 == n_pad) || (id != k_src[p + 1]);
+    lrow_last[p] = last ? static_cast<float>(id % tile) : 0.0f;
+    if (p % chunk == 0) {
+      starts[p / chunk] = static_cast<int32_t>(nu - 1);
+      firsts[p / chunk] = first ? 1 : 0;
+    }
+    if ((p + 1) % chunk == 0) {
+      ends[p / chunk] = static_cast<int32_t>(nu - 1);
+    }
+  }
+  while (t <= n_tiles) tile_start[t++] = static_cast<int32_t>(nu);
+  firsts[n_chunks] = 1;
+  return nu_real;
+}
+
 }  // extern "C"

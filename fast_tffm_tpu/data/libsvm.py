@@ -81,6 +81,26 @@ def hash_bucket(token: str, vocabulary_size: int) -> int:
     return murmur64(token.encode("utf-8")) % vocabulary_size
 
 
+class SortMeta(NamedTuple):
+    """Host-precomputed sparse-apply prep (see native.sort_meta).
+
+    Everything ops/sparse_apply derives from the batch's ids alone —
+    stable sort permutation, unique positions, chunk/tile boundary
+    metadata — computed by the C++ layer on pipeline threads so the
+    device step skips its ~11 ms XLA sort (+ boundary searches).  All
+    shapes depend on (CHUNK, TILE, vocab), which the producer and the
+    kernels must agree on; sparse_apply verifies at trace time.
+    """
+
+    perm: np.ndarray  # [n_pad] i32 occurrence index per sorted position
+    upos: np.ndarray  # [n_pad] i32 unique-segment index per sorted pos
+    lrow_last: np.ndarray  # [n_pad] f32 (id % TILE) at segment ends
+    starts: np.ndarray  # [n_pad/CHUNK] i32 upos at chunk starts
+    firsts: np.ndarray  # [n_pad/CHUNK + 1] i32 seg-start flag at chunks
+    ends: np.ndarray  # [n_pad/CHUNK] i32 upos at chunk ends
+    tile_start: np.ndarray  # [vocab/TILE + 1] i32
+
+
 class Batch(NamedTuple):
     """A fixed-shape parsed batch, ready for the device.
 
@@ -93,6 +113,7 @@ class Batch(NamedTuple):
     vals: np.ndarray  # [B, F] float32 feature values (0 = padding)
     fields: np.ndarray  # [B, F] int32 field ids (all 0 for plain FM)
     weights: np.ndarray  # [B] float32 per-example weights
+    sort_meta: Optional[SortMeta] = None  # host prep for the tile apply
 
 
 class Example(NamedTuple):

@@ -93,8 +93,58 @@ def _load() -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
         ctypes.c_void_p,
     ]
+    lib.fm_sort_meta.restype = ctypes.c_int64
+    lib.fm_sort_meta.argtypes = [
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # ids
+        ctypes.c_int64,  # n
+        ctypes.c_int64,  # n_pad
+        ctypes.c_int64,  # vocab
+        ctypes.c_int64,  # chunk
+        ctypes.c_int64,  # tile
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # perm
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # upos
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # starts
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # firsts
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # ends
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # tile_start
+    ]
     _lib = lib
     return lib
+
+
+def sort_meta(ids, vocab: int, chunk: int, tile: int):
+    """Host-side sparse-apply prep for one batch's flat ids.
+
+    Mirrors ops/sparse_apply._prep's id-derived outputs exactly (stable
+    sort, sentinel padding to a CHUNK multiple); parity is test-enforced.
+    Returns a :class:`fast_tffm_tpu.data.libsvm.SortMeta`.
+    """
+    from fast_tffm_tpu.data.libsvm import SortMeta
+
+    lib = _load()
+    ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int32)
+    n = ids.shape[0]
+    n_pad = -(-n // chunk) * chunk
+    n_chunks = n_pad // chunk
+    n_tiles = vocab // tile
+    perm = np.empty((n_pad,), np.int32)
+    upos = np.empty((n_pad,), np.int32)
+    lrow_last = np.empty((n_pad,), np.float32)
+    starts = np.empty((n_chunks,), np.int32)
+    firsts = np.empty((n_chunks + 1,), np.int32)
+    ends = np.empty((n_chunks,), np.int32)
+    tile_start = np.empty((n_tiles + 1,), np.int32)
+    rc = lib.fm_sort_meta(
+        ids, n, n_pad, vocab, chunk, tile,
+        perm, upos, lrow_last, starts, firsts, ends, tile_start,
+    )
+    if rc < 0:
+        raise ValueError(
+            f"fm_sort_meta rejected arguments: n={n} vocab={vocab} "
+            f"chunk={chunk} tile={tile}"
+        )
+    return SortMeta(perm, upos, lrow_last, starts, firsts, ends, tile_start)
 
 
 def find_line_offsets(

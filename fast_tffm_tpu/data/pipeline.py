@@ -259,6 +259,7 @@ class BatchPipeline:
         ordered: bool = False,
         skip_batches: int = 0,
         shard: tuple[int, int] = (0, 1),
+        sort_meta_spec=None,
     ):
         self.files = list(files)
         self.cfg = cfg
@@ -285,6 +286,14 @@ class BatchPipeline:
         # items carry sequence numbers and the consumer reorders.
         self.ordered = ordered
         self._native, self._parser = _make_parser(cfg)
+        # (vocab, chunk, tile) or None: when set, workers attach host-
+        # computed sparse-apply prep (native.sort_meta) to each batch,
+        # moving the device step's id sort onto these threads.  Needs the
+        # native lib; silently skipped if it failed to build (the device
+        # fallback path sorts on-chip).
+        self._sort_meta_spec = (
+            sort_meta_spec if self._native is not None else None
+        )
         # Fast ingest: raw binary chunks + C++ line scan, no Python string
         # per line. Requires the native parser; weight_files need per-line
         # pairing so they stay on the line path. Shuffling permutes LINES
@@ -416,6 +425,12 @@ class BatchPipeline:
                         lines = [c[0] for c in chunk]
                         weights = [c[1] for c in chunk]
                         batch = self._parser(lines, weights)
+                    if self._sort_meta_spec is not None:
+                        from fast_tffm_tpu.data import native as _native
+
+                        batch = batch._replace(sort_meta=_native.sort_meta(
+                            batch.ids, *self._sort_meta_spec
+                        ))
                 except BaseException as e:
                     put_checked(out, _Error(e))
                     continue

@@ -451,6 +451,32 @@ def _cumsum_counts(flags):
     return (within + offs[:, None]).reshape(n).astype(flags.dtype)
 
 
+def _payload(g_sorted, lrow_last):
+    """[g | g^2 | lrow·last] per sorted occurrence, 128-lane padded.
+
+    The minor dim is padded to the 128-lane tile: the unique-entry stream
+    this payload becomes is DMA'd at dynamic offsets (K1 out, K2/K-place
+    in), and Mosaic requires manually sliced HBM memrefs to be
+    lane-aligned ("Slice shape along dimension 1 must be aligned to
+    tiling (128)" on real v5e — auto-pipelined BlockSpecs pad for free,
+    manual `.at[pl.ds(...)]` copies do not).  HBM storage is already
+    physically padded to 128 lanes by tiling, so the zeros cost no extra
+    traffic.
+    """
+    n_pad = g_sorted.shape[0]
+    payload = jnp.concatenate(
+        [g_sorted, g_sorted * g_sorted, lrow_last[:, None]], axis=1
+    )  # [N, 2D+1]
+    lanes = payload.shape[1]
+    lanes_pad = -(-lanes // 128) * 128
+    if lanes_pad != lanes:
+        payload = jnp.concatenate(
+            [payload, jnp.zeros((n_pad, lanes_pad - lanes), payload.dtype)],
+            axis=1,
+        )  # [N, lanes_pad]
+    return payload
+
+
 def _prep(ids, g_rows, vocab):
     """Sort, dedup-position, and chunk-boundary metadata (all XLA)."""
     n = ids.shape[0]
@@ -473,30 +499,42 @@ def _prep(ids, g_rows, vocab):
     nxt = jnp.concatenate([sidx[1:], jnp.full((1,), -2, sidx.dtype)])
     last = (sidx != nxt).astype(jnp.float32)  # segment ends
     lrow = (sidx % TILE).astype(jnp.float32)  # tile-local row, exact < TILE
-    payload = jnp.concatenate(
-        [g_sorted, g_sorted * g_sorted, (lrow * last)[:, None]], axis=1
-    )  # [N, 2D+1]
-    # Pad the minor dim to the 128-lane tile: the unique-entry stream this
-    # payload becomes is DMA'd at dynamic offsets (K1 out, K2/K-place in),
-    # and Mosaic requires manually sliced HBM memrefs to be lane-aligned
-    # ("Slice shape along dimension 1 must be aligned to tiling (128)" on
-    # real v5e — auto-pipelined BlockSpecs pad for free, manual
-    # `.at[pl.ds(...)]` copies do not).  HBM storage is already physically
-    # padded to 128 lanes by tiling, so the zeros cost no extra traffic.
-    lanes = payload.shape[1]
-    lanes_pad = -(-lanes // 128) * 128
-    if lanes_pad != lanes:
-        payload = jnp.concatenate(
-            [payload, jnp.zeros((n_pad, lanes_pad - lanes), payload.dtype)],
-            axis=1,
-        )  # [N, lanes_pad]
+    payload = _payload(g_sorted, lrow * last)
     starts = upos[::CHUNK]
     firsts = jnp.concatenate([flags[::CHUNK], jnp.ones((1,), jnp.int32)])
     ends = upos[CHUNK - 1::CHUNK]
     return payload, upos, starts, firsts, ends, sidx, n_pad
 
 
-def _dedup_and_starts(ids, g_rows, vocab):
+def _dedup_and_starts(ids, g_rows, vocab, meta=None):
+    if meta is not None:
+        n, d = g_rows.shape
+        n_pad = meta.perm.shape[0]
+        # The producer baked CHUNK/TILE into these shapes; a mismatch
+        # means pipeline and kernels disagree on the constants — running
+        # anyway would misplace rows, so fail loudly at trace time.
+        if (
+            n_pad != -(-n // CHUNK) * CHUNK
+            or meta.starts.shape[0] != n_pad // CHUNK
+            or meta.tile_start.shape[0] != vocab // TILE + 1
+        ):
+            raise ValueError(
+                "sort_meta shapes disagree with CHUNK/TILE/vocab: "
+                f"perm={meta.perm.shape} starts={meta.starts.shape} "
+                f"tile_start={meta.tile_start.shape} vs n={n} "
+                f"CHUNK={CHUNK} TILE={TILE} vocab={vocab}"
+            )
+        if n_pad != n:
+            g_rows = jnp.concatenate(
+                [g_rows, jnp.zeros((n_pad - n, d), g_rows.dtype)]
+            )
+        g_sorted = g_rows[meta.perm]
+        payload = _payload(g_sorted, meta.lrow_last)
+        u = _k1_dedup(
+            payload, meta.upos, meta.starts, meta.firsts, meta.ends,
+            n_pad + TILE,
+        )
+        return u, meta.tile_start
     payload, upos, starts, firsts, ends, sidx, n_pad = _prep(
         ids, g_rows, vocab
     )
@@ -507,29 +545,29 @@ def _dedup_and_starts(ids, g_rows, vocab):
     return u, tile_start
 
 
-def adagrad_apply(table, acc, ids, g_rows, *, lr, eps):
+def adagrad_apply(table, acc, ids, g_rows, *, lr, eps, meta=None):
     """Sparse Adagrad over touched rows: exact SparseApplyAdagrad semantics."""
     vocab, d = table.shape
-    u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
+    u, tile_start = _dedup_and_starts(ids, g_rows, vocab, meta)
     update = functools.partial(adagrad_update, lr=lr, eps=eps)
     table, acc = _k2_call(update, tile_start, u, (table, acc), u.shape[1])
     return table, acc
 
 
-def sgd_apply(table, ids, g_rows, *, lr):
+def sgd_apply(table, ids, g_rows, *, lr, meta=None):
     vocab, d = table.shape
-    u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
+    u, tile_start = _dedup_and_starts(ids, g_rows, vocab, meta)
     update = functools.partial(sgd_update, lr=lr)
     (table,) = _k2_call(update, tile_start, u, (table,), u.shape[1])
     return table
 
 
-def ftrl_apply(table, z, n, ids, g_rows, *, lr, l1, l2, beta):
+def ftrl_apply(table, z, n, ids, g_rows, *, lr, l1, l2, beta, meta=None):
     # Recomputing w for untouched rows inside ftrl_update is idempotent:
     # their (z, n) are unchanged and w is always ftrl_solve(z, n)
     # (train.sparse initializes z so this holds from step 0).
     vocab, d = table.shape
-    u, tile_start = _dedup_and_starts(ids, g_rows, vocab)
+    u, tile_start = _dedup_and_starts(ids, g_rows, vocab, meta)
     update = functools.partial(ftrl_update, lr=lr, l1=l1, l2=l2, beta=beta)
     table, z, n = _k2_call(update, tile_start, u, (table, z, n), u.shape[1])
     return table, z, n

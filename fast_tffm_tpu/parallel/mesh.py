@@ -121,6 +121,8 @@ def shard_batch(batch, mesh: Mesh):
     materializing the global batch.
     """
     sh = batch_sharding(mesh)
+    core = ("labels", "ids", "vals", "fields", "weights")
+    meta = getattr(batch, "sort_meta", None)
     if jax.process_count() > 1:
         _, num_blocks = data_partition(mesh)
 
@@ -129,9 +131,16 @@ def shard_batch(batch, mesh: Mesh):
             global_shape = (x.shape[0] * num_blocks,) + x.shape[1:]
             return jax.make_array_from_process_local_data(s, x, global_shape)
 
+        # Host sort-meta describes one process's local ids; it cannot be
+        # assembled into a global batch (the producer never attaches it
+        # multi-process, so this is just defensive).
         return type(batch)(
-            *(put(getattr(batch, k), sh[k]) for k in batch._fields)
+            *(put(getattr(batch, k), sh[k]) for k in core), sort_meta=None
         )
+    if meta is not None:
+        rep = NamedSharding(mesh, P())
+        meta = type(meta)(*(jax.device_put(x, rep) for x in meta))
     return type(batch)(
-        *(jax.device_put(getattr(batch, k), sh[k]) for k in batch._fields)
+        *(jax.device_put(getattr(batch, k), sh[k]) for k in core),
+        sort_meta=meta,
     )

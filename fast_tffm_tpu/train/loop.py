@@ -304,8 +304,53 @@ class Trainer:
         params = init(jax.random.PRNGKey(cfg.seed))
         return params, opt_init(params)
 
-    def _put(self, batch: Batch) -> Batch:
+    def _put(self, batch: Batch, want_meta: bool = True) -> Batch:
+        spec = self._sort_meta_spec() if want_meta else None
+        if spec is not None and batch.sort_meta is None:
+            from fast_tffm_tpu.data import native as native_mod
+
+            try:
+                batch = batch._replace(
+                    sort_meta=native_mod.sort_meta(batch.ids, *spec)
+                )
+            except Exception as e:
+                # Lib unavailable (no g++?) or a real sort_meta bug: the
+                # device-sort path is always correct, so train on — but
+                # say so, or a ~11 ms/step regression has no trail.
+                log.warning(
+                    "host_sort disabled: native sort_meta failed (%s)", e
+                )
+                self._meta_spec = None
         return mesh_lib.shard_batch(batch, self.mesh)
+
+    def _sort_meta_spec(self):
+        """(vocab, CHUNK, TILE) when host-side sort prep applies, else None.
+
+        Host prep rides the single-process tile path only: sharded and
+        scatter applies derive their own metadata, and multi-process
+        batches hold per-host slices the global-sort metadata would not
+        match.  Cached; flips off permanently if the native lib fails.
+        """
+        if hasattr(self, "_meta_spec"):
+            return self._meta_spec
+        spec = None
+        cfg = self.cfg
+        if (
+            cfg.host_sort
+            and jax.process_count() == 1
+            and self.mesh.size == 1
+        ):
+            try:
+                if sparse_lib.apply_mode(cfg, self.mesh) == "tile":
+                    spec = (
+                        cfg.vocabulary_size,
+                        sparse_lib.sparse_apply.CHUNK,
+                        sparse_lib.sparse_apply.TILE,
+                    )
+            except ValueError:
+                spec = None
+        self._meta_spec = spec
+        return spec
 
     def _input_plan(self):
         """(pipeline_cfg, shard, ordered) for host-sharded input.
@@ -408,6 +453,7 @@ class Trainer:
                     skip_batches=self._batches_done,
                     shard=shard,
                     ordered=True,
+                    sort_meta_spec=self._sort_meta_spec(),
                 )
                 for batch in pipeline:
                     if cfg.profile_dir and stepno == cfg.profile_start_step:
@@ -519,7 +565,9 @@ class Trainer:
             ordered=ordered,
         )
         for batch in pipeline:
-            ms = self._eval_step(self.state.params, ms, self._put(batch))
+            ms = self._eval_step(
+                self.state.params, ms, self._put(batch, want_meta=False)
+            )
         return _finalize_metrics(ms, self.cfg.loss_type)
 
     def _data_fingerprint(self) -> dict:

@@ -158,7 +158,7 @@ def _rows_loss_fn(
 
 
 def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows,
-                   mode="scatter", mesh=None):
+                   mode="scatter", mesh=None, meta=None):
     del w_rows  # adagrad needs no pre-update weights
     # Same formula as optax.scale_by_rss: u = g * rsqrt(acc_new + eps),
     # so sparse and dense paths agree exactly on duplicate-free batches.
@@ -172,7 +172,7 @@ def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows,
     elif mode == "tile":
         table, acc_table = sparse_apply.adagrad_apply(
             params.table, opt.acc.table, ids, g_rows,
-            lr=lr, eps=ADAGRAD_EPS,
+            lr=lr, eps=ADAGRAD_EPS, meta=meta,
         )
     else:
         acc_table = opt.acc.table.at[ids].add(g_rows * g_rows)
@@ -193,7 +193,7 @@ _ftrl_solve = sparse_apply.ftrl_solve
 
 
 def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows,
-                mode="scatter", mesh=None):
+                mode="scatter", mesh=None, meta=None):
     lr, l1, l2, beta = (
         cfg.learning_rate, cfg.ftrl_l1, cfg.ftrl_l2, cfg.ftrl_beta,
     )
@@ -206,7 +206,7 @@ def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows,
     elif mode == "tile":
         table, z_table, n_table = sparse_apply.ftrl_apply(
             params.table, opt.z.table, opt.n.table, ids, g_rows,
-            lr=lr, l1=l1, l2=l2, beta=beta,
+            lr=lr, l1=l1, l2=l2, beta=beta, meta=meta,
         )
     else:
         # Rows: FTRL recursion on the touched rows (w_rows is the
@@ -245,7 +245,7 @@ def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows,
 
 
 def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows,
-               mode="scatter", mesh=None):
+               mode="scatter", mesh=None, meta=None):
     del w_rows
     lr = cfg.learning_rate
     if mode == "sharded":
@@ -254,7 +254,8 @@ def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows,
             data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
         )
     elif mode == "tile":
-        table = sparse_apply.sgd_apply(params.table, ids, g_rows, lr=lr)
+        table = sparse_apply.sgd_apply(
+            params.table, ids, g_rows, lr=lr, meta=meta)
     else:
         table = params.table.at[ids].add(-lr * g_rows)
     return fm.FmParams(w0=params.w0 - lr * dw0, table=table), opt
@@ -278,8 +279,10 @@ def sparse_step(
     b, f, d = drows.shape
     ids = batch.ids.reshape(b * f)
     g_rows = drows.reshape(b * f, d)
+    mode = apply_mode(cfg, mesh)
     params, opt_state = _APPLY[cfg.optimizer](
         cfg, params, opt_state, ids, g_rows, dw0, rows.reshape(b * f, d),
-        mode=apply_mode(cfg, mesh), mesh=mesh,
+        mode=mode, mesh=mesh,
+        meta=batch.sort_meta if mode == "tile" else None,
     )
     return params, opt_state, scores

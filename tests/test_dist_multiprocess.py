@@ -229,7 +229,8 @@ def test_host_sharded_input_matches_single_process(tmp_path):
         ]
         for b0, b1 in zip(shards[0], shards[1]):
             gb = Batch(*(np.concatenate([getattr(b0, k), getattr(b1, k)])
-                         for k in Batch._fields))
+                         for k in ("labels", "ids", "vals", "fields",
+                                   "weights")))
             trainer.state = trainer._train_step(
                 trainer.state, trainer._put(gb)
             )
