@@ -107,6 +107,20 @@ def main() -> int:
         f"sorted {ms_s:7.3f} ms", flush=True)
     del packed
 
+    # Transposed-table gather: storing the table as [9, V] (minor dim
+    # dense, sublanes 9->16) cuts its physical HBM footprint ~8x vs the
+    # lane-padded [V, 9], which would shrink K2's table streaming the
+    # same way — IF gathering 640k columns isn't pathological.
+    tb_t = jax.device_put(
+        jnp.asarray(rng.uniform(-1, 1, (9, V)), jnp.float32))
+    cg = jax.jit(lambda tb, i: tb[:, i])
+    ms_r = bench(cg, tb_t, ids)
+    ms_s = bench(cg, tb_t, ids_sorted)
+    print(
+        f"  column-gather [9,V] x {N}: random {ms_r:7.3f} ms  "
+        f"sorted {ms_s:7.3f} ms", flush=True)
+    del tb_t
+
     # ---- lane efficiency of [B, F, 9] elementwise chains --------------
     # fwd/bwd stream [B, F, D] arrays whose minor dim pads 9 -> 128
     # (7% lane use).  Times one representative op in three layouts.
