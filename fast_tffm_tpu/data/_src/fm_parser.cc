@@ -13,6 +13,7 @@
 // (plain -O3, no -march=native: measured faster here, and the cached .so
 // stays portable across CPUs — see data/native.py)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
@@ -482,39 +483,45 @@ int64_t fm_sort_meta(const int32_t* ids, int64_t n, int64_t n_pad,
   }
   const int64_t n_chunks = n_pad / chunk;
   const int64_t n_tiles = vocab / tile;
-  // Stable LSD radix sort of (key=id, payload=index), 4 x 8-bit passes.
-  // Sentinel-padded tail: key == vocab sorts after every real id.
-  std::vector<int32_t> key(n_pad), key2(n_pad), idx(n_pad), idx2(n_pad);
+  if (n_pad > (1LL << 31)) return -1;  // index must fit the low 31 bits
+  // LSD radix sort of packed (id << 31 | index) uint64 keys, 11 bits
+  // per pass over the id bits only.  Sorting by id with the occurrence
+  // index in the low bits makes keys unique and the result stable by
+  // construction (ties in id order by index), matching
+  // jax.lax.sort_key_val with an iota payload.  Sentinel-padded tail:
+  // id == vocab sorts after every real id.
+  constexpr int kIdxBits = 31;
+  constexpr int kRadixBits = 11;
+  constexpr int64_t kBuckets = 1 << kRadixBits;
+  std::vector<uint64_t> key(n_pad), key2(n_pad);
   for (int64_t i = 0; i < n_pad; ++i) {
-    key[i] = i < n ? ids[i] : static_cast<int32_t>(vocab);
-    idx[i] = static_cast<int32_t>(i);
+    const uint64_t id = i < n ? static_cast<uint32_t>(ids[i])
+                              : static_cast<uint64_t>(vocab);
+    key[i] = (id << kIdxBits) | static_cast<uint64_t>(i);
   }
-  int32_t* k_src = key.data();
-  int32_t* k_dst = key2.data();
-  int32_t* i_src = idx.data();
-  int32_t* i_dst = idx2.data();
-  for (int shift = 0; shift < 32; shift += 8) {
-    if ((static_cast<uint64_t>(vocab) >> shift) == 0) break;  // keys done
-    int64_t count[257] = {0};
+  uint64_t* k_src = key.data();
+  uint64_t* k_dst = key2.data();
+  std::vector<int64_t> count(kBuckets + 1);
+  for (int shift = kIdxBits; shift < 64; shift += kRadixBits) {
+    if ((static_cast<uint64_t>(vocab) >> (shift - kIdxBits)) == 0) break;
+    std::fill(count.begin(), count.end(), 0);
     for (int64_t i = 0; i < n_pad; ++i) {
-      ++count[((static_cast<uint32_t>(k_src[i]) >> shift) & 0xFF) + 1];
+      ++count[((k_src[i] >> shift) & (kBuckets - 1)) + 1];
     }
-    for (int b = 0; b < 256; ++b) count[b + 1] += count[b];
+    for (int64_t b = 0; b < kBuckets; ++b) count[b + 1] += count[b];
     for (int64_t i = 0; i < n_pad; ++i) {
-      int64_t pos = count[(static_cast<uint32_t>(k_src[i]) >> shift) & 0xFF]++;
-      k_dst[pos] = k_src[i];
-      i_dst[pos] = i_src[i];
+      k_dst[count[(k_src[i] >> shift) & (kBuckets - 1)]++] = k_src[i];
     }
     std::swap(k_src, k_dst);
-    std::swap(i_src, i_dst);
   }
   // One scan: uniques, chunk metadata, tile boundaries.
   int64_t nu = 0;        // uniques so far (including sentinels at tail)
   int64_t nu_real = 0;   // uniques among real ids
   int64_t t = 0;         // next tile boundary to place (value t * tile)
   for (int64_t p = 0; p < n_pad; ++p) {
-    const int32_t id = k_src[p];
-    const bool first = (p == 0) || (id != k_src[p - 1]);
+    const int64_t id = static_cast<int64_t>(k_src[p] >> kIdxBits);
+    const bool first = (p == 0) || (id != static_cast<int64_t>(
+                                        k_src[p - 1] >> kIdxBits));
     if (first) {
       while (t <= n_tiles && t * tile <= id) {
         tile_start[t++] = static_cast<int32_t>(nu);
@@ -522,9 +529,10 @@ int64_t fm_sort_meta(const int32_t* ids, int64_t n, int64_t n_pad,
       ++nu;
       if (id < vocab) ++nu_real;
     }
-    perm[p] = i_src[p];
+    perm[p] = static_cast<int32_t>(k_src[p] & ((1u << kIdxBits) - 1));
     upos[p] = static_cast<int32_t>(nu - 1);
-    const bool last = (p + 1 == n_pad) || (id != k_src[p + 1]);
+    const bool last = (p + 1 == n_pad) || (id != static_cast<int64_t>(
+                                               k_src[p + 1] >> kIdxBits));
     lrow_last[p] = last ? static_cast<float>(id % tile) : 0.0f;
     if (p % chunk == 0) {
       starts[p / chunk] = static_cast<int32_t>(nu - 1);
