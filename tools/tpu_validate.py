@@ -237,24 +237,30 @@ def main() -> int:
     from fast_tffm_tpu.train.loop import Trainer
 
     combos = [
-        ("scatter", False, "float32", 0),
-        ("scatter", True, "float32", 0),
-        ("tile", False, "float32", 0),
-        ("tile", True, "float32", 0),
-        ("tile", True, "bfloat16", 0),  # the fast path's bf16 variant
+        # (sparse_apply, use_pallas, dtype, field_num, host_sort)
+        ("scatter", False, "float32", 0, True),
+        ("scatter", True, "float32", 0, True),
+        ("tile", False, "float32", 0, True),
+        # host_sort on/off at the default config: isolates the win from
+        # moving the id sort + prep metadata onto pipeline threads.
+        ("tile", True, "float32", 0, False),
+        ("tile", True, "float32", 0, True),
+        ("tile", True, "bfloat16", 0, True),  # the fast path's bf16 variant
         # Field-aware FM (BASELINE config 5): einsum interaction + the
         # same sparse apply machinery; a hardware window must prove this
         # path compiles and runs too, not just plain FM.
-        ("tile", True, "float32", 4),
+        ("tile", True, "float32", 4, True),
     ]
-    for mode, use_pallas, dtype, field_num in combos:
+    for mode, use_pallas, dtype, field_num, host_sort in combos:
         cfg = FmConfig(
             vocabulary_size=V, factor_num=K, max_features=F,
             batch_size=B, learning_rate=0.05, log_steps=0,
             sparse_apply=mode, use_pallas=use_pallas,
             compute_dtype=dtype, field_num=field_num,
+            host_sort=host_sort,
             model_file=(
                 f"/tmp/tpuval_{mode}_{int(use_pallas)}_{dtype}_{field_num}"
+                f"_{int(host_sort)}"
             ),
         )
         shutil.rmtree(cfg.model_file, ignore_errors=True)
@@ -291,6 +297,7 @@ def main() -> int:
                 f"sparse_apply={mode} use_pallas={use_pallas} "
                 f"compute_dtype={dtype}"
                 + (f" field_num={field_num}" if field_num else "")
+                + ("" if host_sort else " host_sort=off")
             ),
             "ms_per_step": round(ms, 2),
             "examples_per_sec": round(B * steps / dt, 1),
