@@ -84,6 +84,66 @@ class TestSparseApplyLowering:
         finally:
             sparse_apply.CHUNK, sparse_apply.TILE = orig
 
+    @pytest.mark.parametrize(
+        "chunk,tile,k1_group,group",
+        [
+            (512, 256, 1, 1),
+            (512, 256, 4, 16),
+            # Small blocks so the big groups actually materialize:
+            # N/CHUNK = 16 chunks and V/TILE = 32 tiles — _group_for
+            # would silently clamp them at the default block sizes and
+            # lower the same kernel as the case above.
+            (128, 128, 16, 32),
+        ],
+    )
+    def test_adagrad_apply_alternate_groups(self, chunk, tile, k1_group,
+                                            group):
+        """Every K1_GROUP/GROUP value the hardware sweep tries must pass
+        Mosaic lowering — the unrolled window loops and their semaphore
+        protocols change shape with the group counts."""
+        orig = (sparse_apply.CHUNK, sparse_apply.TILE,
+                sparse_apply.K1_GROUP, sparse_apply.GROUP)
+        sparse_apply.CHUNK = chunk
+        sparse_apply.TILE = tile
+        sparse_apply.K1_GROUP = k1_group
+        sparse_apply.GROUP = group
+        try:
+            assert sparse_apply._group_for(N // chunk, k1_group) == k1_group
+            assert sparse_apply._group_for(V // tile) == group
+            lower_tpu(
+                functools.partial(
+                    sparse_apply.adagrad_apply, lr=0.1, eps=1e-7
+                ),
+                _s((V, D)), _s((V, D)), _s((N,), jnp.int32), _s((N, D)),
+            )
+        finally:
+            (sparse_apply.CHUNK, sparse_apply.TILE,
+             sparse_apply.K1_GROUP, sparse_apply.GROUP) = orig
+
+    def test_adagrad_apply_with_host_meta(self):
+        """The host-sort fast path reshapes the kernel inputs (prefetched
+        metadata instead of in-graph sort); it must lower for TPU too."""
+        n_pad = -(-N // sparse_apply.CHUNK) * sparse_apply.CHUNK
+        n_chunks = n_pad // sparse_apply.CHUNK
+        n_tiles = V // sparse_apply.TILE
+        from fast_tffm_tpu.data.libsvm import SortMeta
+
+        meta = SortMeta(
+            perm=_s((n_pad,), jnp.int32),
+            upos=_s((n_pad,), jnp.int32),
+            lrow_last=_s((n_pad,), jnp.float32),
+            starts=_s((n_chunks,), jnp.int32),
+            firsts=_s((n_chunks + 1,), jnp.int32),
+            ends=_s((n_chunks,), jnp.int32),
+            tile_start=_s((n_tiles + 1,), jnp.int32),
+        )
+        lower_tpu(
+            lambda t, a, i, g, m: sparse_apply.adagrad_apply(
+                t, a, i, g, lr=0.1, eps=1e-7, meta=m
+            ),
+            _s((V, D)), _s((V, D)), _s((N,), jnp.int32), _s((N, D)), meta,
+        )
+
 
 class TestFmKernelLowering:
     @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
