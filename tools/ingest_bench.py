@@ -72,6 +72,23 @@ def main() -> int:
             emit("parse", total / (time.perf_counter() - t0),
                  internal_threads=nt)
 
+        # sort_meta: the host-side sparse-apply prep that rides the same
+        # worker threads when host_sort engages (single-process tile).
+        from fast_tffm_tpu.ops import sparse_apply
+
+        ids = rng.integers(0, VOCAB, (BATCH * NFEAT,)).astype(np.int32)
+        native_lib.sort_meta(
+            ids, VOCAB, sparse_apply.CHUNK, sparse_apply.TILE
+        )
+        t0 = time.perf_counter()
+        reps = 10
+        for _ in range(reps):
+            native_lib.sort_meta(
+                ids, VOCAB, sparse_apply.CHUNK, sparse_apply.TILE
+            )
+        emit("sort_meta", reps * BATCH * NFEAT / (time.perf_counter() - t0),
+             note="feature occurrences/sec, one core")
+
         for tn in (1, 2, 4, 8):
             for ordered in (False, True):
                 cfg = FmConfig(
@@ -88,6 +105,26 @@ def main() -> int:
                     n += BATCH
                 emit("pipeline", n / (time.perf_counter() - t0),
                      thread_num=tn, ordered=ordered)
+
+        # Pipeline with per-batch sort_meta on the workers: what the
+        # training path actually runs when host_sort engages.
+        for tn in (4, 8):
+            cfg = FmConfig(
+                vocabulary_size=VOCAB, factor_num=8, max_features=NFEAT,
+                batch_size=BATCH, thread_num=tn, queue_size=8,
+            )
+            pipe = BatchPipeline(
+                files, cfg, epochs=2, shuffle=True,
+                sort_meta_spec=(
+                    VOCAB, sparse_apply.CHUNK, sparse_apply.TILE
+                ),
+            )
+            t0 = time.perf_counter()
+            n = 0
+            for _b in pipe:
+                n += BATCH
+            emit("pipeline+meta", n / (time.perf_counter() - t0),
+                 thread_num=tn)
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return 0
