@@ -151,6 +151,67 @@ def main() -> int:
     # one-hot matmul gather at 128 width for contrast (tile-streamed
     # idea lower bound, measured as pure XLA): skipped, O(N*V) infeasible.
 
+    # ---- reshape relayout + forward-path variants ---------------------
+    # fm_pallas calls rows.reshape(b, F*D) "a free bitcast" — on TPU the
+    # two shapes tile differently ([B,F,9] pads 9->128 lanes; [B,351]
+    # pads to 384), so the reshape may be a real relayout copy.  Time it,
+    # and time three full forward implementations: the production jnp
+    # oracle, the Pallas kernel, and a pure-XLA version of the kernel's
+    # flat one-hot-matmul math (no Pallas overhead; XLA free to fuse).
+    Dd = 9
+    rows3, vals2 = r3, vals2  # reuse the lane-efficiency section's arrays
+    t_resh = bench(
+        jax.jit(lambda r: r.reshape(B, F * Dd) + 1.0), rows3)
+    t_noop = bench(jax.jit(lambda r: r + 1.0), rows3)
+    print(
+        f"  reshape [B,F,9]->[B,351] (+1): {t_resh:6.3f} ms   "
+        f"(+1 alone in 3-D: {t_noop:6.3f} ms)", flush=True)
+
+    from fast_tffm_tpu.ops import fm_pallas, interaction
+
+    def fwd_flat_xla(rows, vals):
+        fd = F * Dd
+        rows2 = rows.reshape(-1, fd)
+        r_mat = (jax.lax.broadcasted_iota(jnp.int32, (F, fd), 1) // Dd
+                 == jax.lax.broadcasted_iota(jnp.int32, (F, fd), 0)
+                 ).astype(rows2.dtype)
+        m_mat = (jax.lax.broadcasted_iota(jnp.int32, (fd, Dd), 0) % Dd
+                 == jax.lax.broadcasted_iota(jnp.int32, (fd, Dd), 1)
+                 ).astype(rows2.dtype)
+        hi = jax.lax.Precision.HIGHEST  # keep f32 exactness on the MXU
+        xe = jax.lax.dot(vals, r_mat, precision=hi,
+                         preferred_element_type=jnp.float32)
+        y = rows2 * xe
+        s = jax.lax.dot(y, m_mat, precision=hi,
+                        preferred_element_type=jnp.float32)
+        s2 = jax.lax.dot(y * y, m_mat, precision=hi,
+                         preferred_element_type=jnp.float32)
+        s1 = s[:, 1:]
+        return (
+            s[:, 0] + 0.5 * jnp.sum(s1 * s1 - s2[:, 1:], axis=-1), s1
+        )
+
+    import functools
+
+    jnp_fwd = jax.jit(interaction._scores_jnp)
+    flat_fwd = jax.jit(fwd_flat_xla)
+    t_jnp = bench(jnp_fwd, rows3, vals2)
+    if jax.default_backend() != "cpu":
+        # fm_scores_pallas is itself jitted (reshape/pad fused in); the
+        # partial only pins the static interpret flag.
+        t_pal = bench(
+            functools.partial(fm_pallas.fm_scores_pallas, interpret=False),
+            rows3, vals2)
+    else:
+        t_pal = float("nan")  # compiled Pallas needs the chip
+    t_flatx = bench(flat_fwd, rows3, vals2)
+    s_ref, _ = jnp_fwd(rows3, vals2)
+    s_got, _ = flat_fwd(rows3, vals2)
+    err = float(jnp.max(jnp.abs(s_ref - s_got)))
+    print(
+        f"  fwd: jnp {t_jnp:6.3f} ms   pallas {t_pal:6.3f} ms   "
+        f"flat-xla {t_flatx:6.3f} ms (err {err:.1e})", flush=True)
+
     # ---- scatter-add: same axes --------------------------------------
     for d in (9, 128):
         tb = jax.device_put(jnp.zeros((V, d), jnp.float32))
