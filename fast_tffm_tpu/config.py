@@ -114,6 +114,11 @@ class FmConfig:
     compute_dtype: str = "float32"
     # Use the Pallas kernel for the scorer when on TPU.
     use_pallas: bool = True
+    # Interaction implementation: '' derives from use_pallas (True ->
+    # 'pallas', False -> 'jnp'); 'flat' selects the pure-XLA flat-layout
+    # one-hot-matmul variant (same math as the Pallas kernels, fused by
+    # XLA instead).
+    interaction: str = ""
     # Sparse row updates (IndexedSlices-style): optimizer touches only the
     # rows in the batch. Falls back to dense when the optimizer/l2_mode
     # combination requires it (see train.sparse.supports_sparse).
@@ -155,6 +160,8 @@ class FmConfig:
             raise ValueError(f"unknown sparse_apply {self.sparse_apply!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+        if self.interaction not in ("", "pallas", "jnp", "flat"):
+            raise ValueError(f"unknown interaction {self.interaction!r}")
         if self.weight_files and len(self.weight_files) != len(self.train_files):
             raise ValueError(
                 "weight_files must parallel train_files "
@@ -166,6 +173,12 @@ class FmConfig:
         """Width of one table row: 1 linear weight + factor vector(s)."""
         k = self.factor_num
         return 1 + (k * self.field_num if self.field_num else k)
+
+    @property
+    def interaction_impl(self) -> str:
+        if self.interaction:  # validated in __post_init__
+            return self.interaction
+        return "pallas" if self.use_pallas else "jnp"
 
     @property
     def compute_jnp_dtype(self):
@@ -227,6 +240,7 @@ _KEYMAP = {
     "lookup": ("lookup", str),
     "compute_dtype": ("compute_dtype", str),
     "use_pallas": ("use_pallas", _parse_bool),
+    "interaction": ("interaction", str),
     "sparse_update": ("sparse_update", _parse_bool),
     "sparse_apply": ("sparse_apply", str),
     "fast_ingest": ("fast_ingest", _parse_bool),

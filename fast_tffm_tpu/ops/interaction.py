@@ -47,8 +47,70 @@ def _grads_jnp(rows, vals, s1, g):
     return jnp.concatenate([gx, dv], axis=-1).astype(in_dtype)
 
 
+# Flat-layout pure-XLA variant: the Pallas kernels' [B, F*D] one-hot-
+# matmul math, but left to XLA to fuse (no pallas_call).  The [B, F, D]
+# elementwise layout above runs the VPU at D/128 lane utilization; here
+# the hot elementwise chain is [B, F*D] (~91% at F=39, D=9) and the
+# per-feature reductions ride the MXU.  Broadcasts that the kernel
+# builds with R/Mt selection matmuls become repeat/tile (XLA fuses them
+# for free); only the feature-sum keeps a one-hot matmul, because the
+# reshape back to [B, F, D] it would otherwise need is a real relayout
+# on TPU.
+def _m_matrix(fd, d, dtype):
+    """M[c, c % d] = 1: sums row slot j across features on the MXU."""
+    cm = jax.lax.broadcasted_iota(jnp.int32, (fd, d), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (fd, d), 1)
+    return (cm % d == j).astype(dtype)
+
+
+_HI = jax.lax.Precision.HIGHEST  # keep ~f32 exactness on the MXU
+
+
+def _scores_flat(rows, vals):
+    b, f, d = rows.shape
+    rows2 = rows.reshape(b, f * d).astype(jnp.float32)
+    vals = vals.astype(jnp.float32)
+    xe = jnp.repeat(vals, d, axis=1)  # xe[b, f*d+j] = x_f
+    y = rows2 * xe
+    m = _m_matrix(f * d, d, jnp.float32)
+    s = jax.lax.dot(y, m, precision=_HI,
+                    preferred_element_type=jnp.float32)
+    s2 = jax.lax.dot(y * y, m, precision=_HI,
+                     preferred_element_type=jnp.float32)
+    s1 = s[:, 1:]
+    inter = 0.5 * jnp.sum(s1 * s1 - s2[:, 1:], axis=-1)
+    return s[:, 0] + inter, s1
+
+
+def _grads_flat(rows, vals, s1, g):
+    in_dtype = rows.dtype
+    b, f, d = rows.shape
+    rows2 = rows.reshape(b, f * d).astype(jnp.float32)
+    vals = vals.astype(jnp.float32)
+    xe = jnp.repeat(vals, d, axis=1)
+    y = rows2 * xe
+    ones = jnp.ones((b, 1), jnp.float32)
+    # s1e[b, f*d+j] = (1 if j == 0 else s1[b, j-1]): tile, not a matmul.
+    s1e = jnp.tile(jnp.concatenate([ones, s1], axis=1), (1, f))
+    c = jax.lax.broadcasted_iota(jnp.int32, (1, f * d), 1)
+    maskv = (c % d != 0).astype(jnp.float32)  # kill the w column in y
+    drows2 = (g[:, None] * xe) * (s1e - y * maskv)
+    return drows2.reshape(b, f, d).astype(in_dtype)
+
+
+def _impl_name(impl) -> str:
+    """Normalize the static dispatch arg: bools are the legacy surface."""
+    if impl is True:
+        return "pallas"
+    if impl is False:
+        return "jnp"
+    if impl in ("pallas", "jnp", "flat"):
+        return impl
+    raise ValueError(f"unknown interaction impl {impl!r}")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def fm_interaction(rows, vals, use_pallas: bool = True):
+def fm_interaction(rows, vals, use_pallas=True):
     scores, _ = _forward(rows, vals, use_pallas)
     return scores
 
@@ -58,15 +120,16 @@ def fm_interaction_sharded(rows, vals, use_pallas, mesh, data_axis: str):
     GSPMD, so on a multi-device mesh the pallas path must run under
     shard_map with the batch dimension sharded on the data axis (rows/vals
     are replicated across the model axis — the gather already happened)."""
-    if not use_pallas:
-        return fm_interaction(rows, vals, False)
+    impl = _impl_name(use_pallas)
+    if impl != "pallas":  # jnp/flat are plain XLA: GSPMD partitions them
+        return fm_interaction(rows, vals, impl)
     if mesh is None or mesh.size == 1:
-        return fm_interaction(rows, vals, use_pallas)
+        return fm_interaction(rows, vals, impl)
     from jax.sharding import PartitionSpec as P
 
     # check_vma=False: pallas_call out_shapes don't carry vma annotations.
     return jax.shard_map(
-        lambda r, v: fm_interaction(r, v, use_pallas),
+        lambda r, v: fm_interaction(r, v, "pallas"),
         mesh=mesh,
         in_specs=(P(data_axis, None, None), P(data_axis, None)),
         out_specs=P(data_axis),
@@ -74,23 +137,29 @@ def fm_interaction_sharded(rows, vals, use_pallas, mesh, data_axis: str):
     )(rows, vals)
 
 
-def _forward(rows, vals, use_pallas):
-    if use_pallas:
+def _forward(rows, vals, impl):
+    impl = _impl_name(impl)
+    if impl == "pallas":
         return fm_pallas.fm_scores_pallas(rows, vals,
                                           interpret=_use_interpret())
+    if impl == "flat":
+        return _scores_flat(rows, vals)
     return _scores_jnp(rows, vals)
 
 
-def _fwd(rows, vals, use_pallas):
-    scores, s1 = _forward(rows, vals, use_pallas)
+def _fwd(rows, vals, impl):
+    scores, s1 = _forward(rows, vals, impl)
     return scores, (rows, vals, s1)
 
 
-def _bwd(use_pallas, res, g):
+def _bwd(impl, res, g):
     rows, vals, s1 = res
-    if use_pallas:
+    impl = _impl_name(impl)
+    if impl == "pallas":
         drows = fm_pallas.fm_grad_pallas(rows, vals, s1, g,
                                          interpret=_use_interpret())
+    elif impl == "flat":
+        drows = _grads_flat(rows, vals, s1, g)
     else:
         drows = _grads_jnp(rows, vals, s1, g)
     return drows, None  # no gradient w.r.t. vals

@@ -245,11 +245,11 @@ class Trainer:
         from fast_tffm_tpu.platform import use_interpret
 
         log.info(
-            "step build: sparse=%s apply_mode=%s pallas=%s interpret=%s "
-            "backend=%s mesh=%s",
+            "step build: sparse=%s apply_mode=%s interaction=%s "
+            "interpret=%s backend=%s mesh=%s",
             self.sparse,
             sparse_lib.apply_mode(cfg, self.mesh) if self.sparse else "dense",
-            cfg.use_pallas, use_interpret(), jax.default_backend(),
+            cfg.interaction_impl, use_interpret(), jax.default_backend(),
             dict(self.mesh.shape),
         )
         self._train_step = jax.jit(

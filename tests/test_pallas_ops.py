@@ -44,7 +44,7 @@ def test_pallas_backward_matches_closed_form(problem, rng):
                                rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("use_pallas", [False, True])
+@pytest.mark.parametrize("use_pallas", [False, True, "flat"])
 def test_interaction_custom_vjp_matches_autodiff(problem, use_pallas):
     """The closed-form FmGrad must equal autodiff through the oracle."""
     rows, vals = problem
@@ -115,3 +115,39 @@ def test_pallas_kernels_odd_batch_sizes(rng, b):
     assert drows_p.shape == (b, f, 1 + k)
     np.testing.assert_allclose(np.asarray(drows_p), np.asarray(drows_o),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_flat_forward_matches_oracle(problem):
+    rows, vals = problem
+    scores_f, s1_f = interaction._scores_flat(rows, vals)
+    scores_o, s1_o = interaction._scores_jnp(rows, vals)
+    np.testing.assert_allclose(np.asarray(scores_f), np.asarray(scores_o),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1_f), np.asarray(s1_o),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flat_backward_matches_closed_form(problem, rng):
+    rows, vals = problem
+    _, s1 = interaction._scores_jnp(rows, vals)
+    g = jnp.asarray(rng.normal(size=(rows.shape[0],)).astype(np.float32))
+    drows_f = interaction._grads_flat(rows, vals, s1, g)
+    drows_o = interaction._grads_jnp(rows, vals, s1, g)
+    np.testing.assert_allclose(np.asarray(drows_f), np.asarray(drows_o),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flat_bf16_keeps_cotangent_dtype(problem, rng):
+    rows, vals = problem
+    rows16 = rows.astype(jnp.bfloat16)
+    vals16 = vals.astype(jnp.bfloat16)
+    scores, s1 = interaction._scores_flat(rows16, vals16)
+    assert scores.dtype == jnp.float32 and s1.dtype == jnp.float32
+    g = jnp.asarray(rng.normal(size=(rows.shape[0],)).astype(np.float32))
+    drows = interaction._grads_flat(rows16, vals16, s1, g)
+    assert drows.dtype == jnp.bfloat16
+
+
+def test_interaction_impl_name_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown interaction impl"):
+        interaction._impl_name("cuda")

@@ -246,6 +246,7 @@ def main() -> int:
         ("tile", True, "float32", 0, False),
         ("tile", True, "float32", 0, True),
         ("tile", True, "bfloat16", 0, True),  # the fast path's bf16 variant
+        ("tile", "flat", "float32", 0, True),  # pure-XLA flat interaction
         # Field-aware FM (BASELINE config 5): einsum interaction + the
         # same sparse apply machinery; a hardware window must prove this
         # path compiles and runs too, not just plain FM.
@@ -255,11 +256,13 @@ def main() -> int:
         cfg = FmConfig(
             vocabulary_size=V, factor_num=K, max_features=F,
             batch_size=B, learning_rate=0.05, log_steps=0,
-            sparse_apply=mode, use_pallas=use_pallas,
+            sparse_apply=mode,
+            use_pallas=(use_pallas is True),
+            interaction="flat" if use_pallas == "flat" else "",
             compute_dtype=dtype, field_num=field_num,
             host_sort=host_sort,
             model_file=(
-                f"/tmp/tpuval_{mode}_{int(use_pallas)}_{dtype}_{field_num}"
+                f"/tmp/tpuval_{mode}_{use_pallas}_{dtype}_{field_num}"
                 f"_{int(host_sort)}"
             ),
         )
@@ -294,7 +297,7 @@ def main() -> int:
         ms = dt * 1e3 / steps
         emit(json.dumps({
             "step": (
-                f"sparse_apply={mode} use_pallas={use_pallas} "
+                f"sparse_apply={mode} interaction={cfg.interaction_impl} "
                 f"compute_dtype={dtype}"
                 + (f" field_num={field_num}" if field_num else "")
                 + ("" if host_sort else " host_sort=off")
