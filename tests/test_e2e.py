@@ -69,6 +69,17 @@ def test_train_reduces_logloss_and_checkpoints(sample_data, tmp_path):
 
 
 @pytest.mark.slow
+def test_flat_interaction_trains_multi_device(sample_data, tmp_path):
+    """interaction=flat is plain XLA and must train under the 8-virtual-
+    device GSPMD mesh (the Pallas path needs shard_map there); same
+    convergence bar as the default path."""
+    cfg = _cfg(sample_data, tmp_path, interaction="flat")
+    result = Trainer(cfg).train()
+    assert result["validation"]["logloss"] < 0.55
+    assert result["validation"]["auc"] > 0.72
+
+
+@pytest.mark.slow
 def test_sorted_data_converges_with_line_shuffle(sample_data, tmp_path):
     """Convergence on a LABEL-SORTED file (the norm for CTR logs): fast
     ingest's line-level shuffle must recover most of the loss an
