@@ -151,3 +151,16 @@ def test_flat_bf16_keeps_cotangent_dtype(problem, rng):
 def test_interaction_impl_name_rejects_unknown():
     with pytest.raises(ValueError, match="unknown interaction impl"):
         interaction._impl_name("cuda")
+
+
+def test_interaction_check_grads(problem):
+    """SURVEY.md §4 item 2: gradient-check the interaction op numerically
+    (second-order finite differences), not just against the closed form."""
+    from jax.test_util import check_grads
+
+    rows, vals = problem
+    for impl in (False, "flat"):
+        check_grads(
+            lambda r: interaction.fm_interaction(r, vals, impl),
+            (rows,), order=1, modes=("rev",), atol=5e-2, rtol=5e-2,
+        )
