@@ -484,35 +484,83 @@ int64_t fm_sort_meta(const int32_t* ids, int64_t n, int64_t n_pad,
   const int64_t n_chunks = n_pad / chunk;
   const int64_t n_tiles = vocab / tile;
   if (n_pad > (1LL << 31)) return -1;  // index must fit the low 31 bits
-  // LSD radix sort of packed (id << 31 | index) uint64 keys, 11 bits
-  // per pass over the id bits only.  Sorting by id with the occurrence
-  // index in the low bits makes keys unique and the result stable by
-  // construction (ties in id order by index), matching
+  // Stable sort of packed (id << 31 | index) uint64 keys, MSB-bucket
+  // first: one scattered pass distributes keys into ~2048 top-bit
+  // buckets (the only cache-hostile pass — LSD radix paid this miss
+  // cost on EVERY pass), then each small bucket is finished with
+  // cache-resident 11-bit counting passes over the remaining low id
+  // bits.  The occurrence index lives in the low 31 key bits and is
+  // never sorted on, so equal ids keep occurrence order — matching
   // jax.lax.sort_key_val with an iota payload.  Sentinel-padded tail:
-  // id == vocab sorts after every real id.
+  // id == vocab sorts after every real id (own top-bit bucket or own
+  // low-bit value).
   constexpr int kIdxBits = 31;
   constexpr int kRadixBits = 11;
-  constexpr int64_t kBuckets = 1 << kRadixBits;
+  constexpr int64_t kRadix = 1 << kRadixBits;
+  int id_bits = 0;
+  while ((static_cast<uint64_t>(vocab) >> id_bits) != 0) ++id_bits;
+  // Up to 12 top bits (<= 4097 buckets, ~32KB of bucket offsets): the
+  // common vocab = 2^22 (23 id bits incl. the sentinel) then leaves 11
+  // low bits — exactly one cache-hot pass per bucket.
+  const int top_bits = id_bits < 12 ? id_bits : 12;
+  const int lo_bits = id_bits - top_bits;
+  const int64_t n_buckets =
+      (static_cast<int64_t>(vocab) >> lo_bits) + 1;  // top-bits range
   std::vector<uint64_t> key(n_pad), key2(n_pad);
   for (int64_t i = 0; i < n_pad; ++i) {
     const uint64_t id = i < n ? static_cast<uint32_t>(ids[i])
                               : static_cast<uint64_t>(vocab);
     key[i] = (id << kIdxBits) | static_cast<uint64_t>(i);
   }
-  uint64_t* k_src = key.data();
-  uint64_t* k_dst = key2.data();
-  std::vector<int64_t> count(kBuckets + 1);
-  for (int shift = kIdxBits; shift < 64; shift += kRadixBits) {
-    if ((static_cast<uint64_t>(vocab) >> (shift - kIdxBits)) == 0) break;
-    std::fill(count.begin(), count.end(), 0);
+  // Pass A+B: bucket histogram over the top id bits, then scatter.
+  std::vector<int64_t> bstart(n_buckets + 1, 0);
+  const int top_shift = kIdxBits + lo_bits;
+  for (int64_t i = 0; i < n_pad; ++i) {
+    ++bstart[(key[i] >> top_shift) + 1];
+  }
+  for (int64_t b = 0; b < n_buckets; ++b) bstart[b + 1] += bstart[b];
+  {
+    std::vector<int64_t> pos(bstart.begin(), bstart.end() - 1);
     for (int64_t i = 0; i < n_pad; ++i) {
-      ++count[((k_src[i] >> shift) & (kBuckets - 1)) + 1];
+      key2[pos[key[i] >> top_shift]++] = key[i];
     }
-    for (int64_t b = 0; b < kBuckets; ++b) count[b + 1] += count[b];
-    for (int64_t i = 0; i < n_pad; ++i) {
-      k_dst[count[(k_src[i] >> shift) & (kBuckets - 1)]++] = k_src[i];
+  }
+  // Per bucket: LSD counting passes over the low id bits (cache-hot:
+  // buckets average n/2048 keys).  lo_bits == 0 means a bucket holds
+  // one id value only — already sorted (scatter preserved order).
+  uint64_t* k_src = key2.data();  // scan reads from k_src when done
+  uint64_t* k_dst = key.data();
+  if (lo_bits > 0) {
+    int64_t count[kRadix + 1];
+    for (int64_t b = 0; b < n_buckets; ++b) {
+      uint64_t* src = k_src + bstart[b];
+      uint64_t* dst = k_dst + bstart[b];
+      const int64_t m = bstart[b + 1] - bstart[b];
+      if (m <= 1) {
+        if (m == 1) dst[0] = src[0];
+        continue;
+      }
+      for (int shift = 0; shift < lo_bits; shift += kRadixBits) {
+        const int bits = std::min(kRadixBits, lo_bits - shift);
+        const uint64_t mask = (1u << bits) - 1;
+        std::fill(count, count + (1 << bits) + 1, 0);
+        for (int64_t i = 0; i < m; ++i) {
+          ++count[((src[i] >> (kIdxBits + shift)) & mask) + 1];
+        }
+        for (int64_t v = 0; v < (1 << bits); ++v) count[v + 1] += count[v];
+        for (int64_t i = 0; i < m; ++i) {
+          dst[count[(src[i] >> (kIdxBits + shift)) & mask]++] = src[i];
+        }
+        std::swap(src, dst);
+      }
+      // After the pass loop `src` points at the buffer holding the
+      // sorted run (the swaps alternate); normalize every bucket into
+      // k_dst's region so one buffer holds the full sorted sequence.
+      if (src != k_dst + bstart[b]) {
+        std::memcpy(k_dst + bstart[b], src, m * sizeof(uint64_t));
+      }
     }
-    std::swap(k_src, k_dst);
+    k_src = k_dst;  // scan reads the normalized buffer
   }
   // One scan: uniques, chunk metadata, tile boundaries.
   int64_t nu = 0;        // uniques so far (including sentinels at tail)

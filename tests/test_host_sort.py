@@ -79,6 +79,24 @@ def test_sort_meta_matches_device_prep(n, hot):
         )
 
 
+@pytest.mark.parametrize("vocab", [1 << 13, 1 << 24])
+def test_sort_meta_matches_device_prep_large_vocab(vocab):
+    """Large vocabularies exercise the per-bucket low-bit sort passes
+    (vocab 2^13: one cache-hot pass; 2^24: two, covering the ping-pong
+    buffer normalization) — the default V=2048 cases have lo_bits == 0
+    and skip that code entirely."""
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, vocab, (3000,)).astype(np.int32)
+    ids[:800] = 123  # a hot id spanning chunks
+    meta = native.sort_meta(ids, vocab, sparse_apply.CHUNK,
+                            sparse_apply.TILE)
+    dev = _device_meta(ids, vocab)
+    for name in dev:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(meta, name)), dev[name], err_msg=name
+        )
+
+
 def test_sort_meta_is_stable_for_duplicates():
     ids = np.asarray([5, 3, 5, 5, 3, 7], np.int32)
     meta = native.sort_meta(ids, V, sparse_apply.CHUNK, sparse_apply.TILE)

@@ -159,7 +159,7 @@ def test_interaction_check_grads(problem):
     from jax.test_util import check_grads
 
     rows, vals = problem
-    for impl in (False, "flat"):
+    for impl in (False, "flat", True):  # True = pallas (interpret on CPU)
         check_grads(
             lambda r: interaction.fm_interaction(r, vals, impl),
             (rows,), order=1, modes=("rev",), atol=5e-2, rtol=5e-2,
