@@ -205,6 +205,134 @@ def main() -> int:
             f"sorted {ms_s:7.3f} ms", flush=True)
         del tb, g
 
+    # ---- transposed-K2 prototype --------------------------------------
+    # The production K2 streams the [V, 9] table whose HBM rows are
+    # 128-lane padded (~14x physical traffic if the memory_stats probe
+    # above confirms tiling).  This prototype streams a TRANSPOSED
+    # [9, V] table in column blocks (dense minor dim; sublanes pad
+    # 9->16, only ~1.8x) with the placement matmul transposed to match.
+    # If it wins by the traffic ratio, the table-layout redesign is
+    # justified; adagrad only, same windowed u stream as production K2.
+    from functools import partial as _partial
+
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    from fast_tffm_tpu.ops import sparse_apply as sa
+
+    def _k2t_kernel(ts_ref, table_ref, acc_ref, u_hbm_ref, table_out_ref,
+                    acc_out_ref, u_vmem, sem, *, tile, group, d, lr, eps):
+        base = pl.program_id(0) * group
+
+        def window(j, slot):
+            start = ts_ref[base + j]
+            return pltpu.make_async_copy(
+                u_hbm_ref.at[pl.ds(start, tile)], u_vmem.at[slot],
+                sem.at[slot],
+            )
+
+        window(0, 0).start()
+        for j in range(group):
+            slot = j % 2
+            if j + 1 < group:
+                window(j + 1, (j + 1) % 2).start()
+            window(j, slot).wait()
+            start = ts_ref[base + j]
+            cnt = ts_ref[base + j + 1] - start
+            u = u_vmem[slot]  # [R, L]
+            e_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
+            u = jnp.where(e_iota < cnt, u, 0.0)
+            lrow = u[:, 2 * d:2 * d + 1].astype(jnp.int32)
+            r_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
+            p = ((lrow == r_iota) & (e_iota < cnt)).astype(jnp.bfloat16)
+            u_hi = u.astype(jnp.bfloat16)
+            u_lo = (u - u_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+            dn = (((0,), (0,)), ((), ()))  # contract entries -> [L, R]
+            dense_t = (
+                jax.lax.dot_general(u_hi, p, dn,
+                                    preferred_element_type=jnp.float32)
+                + jax.lax.dot_general(u_lo, p, dn,
+                                      preferred_element_type=jnp.float32)
+            )
+            g1t = dense_t[:d, :]  # [D, R]
+            g2t = dense_t[d:2 * d, :]
+            cols = pl.ds(j * tile, tile)
+            acc_new = acc_ref[:, cols] + g2t
+            table_out_ref[:, cols] = table_ref[:, cols] - lr * g1t * (
+                jax.lax.rsqrt(acc_new + eps))
+            acc_out_ref[:, cols] = acc_new
+
+    def k2t_apply(table_t, acc_t, ids_, g_rows, *, lr, eps):
+        vocab = table_t.shape[1]
+        d = table_t.shape[0]
+        u, tile_start = sa._dedup_and_starts(ids_, g_rows, vocab)
+        tile, group = sa.TILE, sa._group_for(vocab // sa.TILE)
+        block = tile * group
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(vocab // block,),
+            in_specs=[pl.BlockSpec((d, block), lambda t, *_: (0, t))] * 2
+            + [pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=[pl.BlockSpec((d, block), lambda t, *_: (0, t))] * 2,
+            scratch_shapes=[
+                pltpu.VMEM((2, tile, u.shape[1]), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        )
+        return pl.pallas_call(
+            _partial(_k2t_kernel, tile=tile, group=group, d=d, lr=lr,
+                     eps=eps),
+            grid_spec=grid_spec,
+            out_shape=[jax.ShapeDtypeStruct((d, vocab), jnp.float32)] * 2,
+            input_output_aliases={1: 0, 2: 1},
+            interpret=jax.default_backend() == "cpu",
+        )(tile_start, table_t, acc_t, u)
+
+    d9 = 9
+    gk = jax.device_put(
+        jnp.asarray(rng.uniform(-1e-2, 1e-2, (N, d9)), jnp.float32))
+    tbl = jax.device_put(
+        jnp.asarray(rng.uniform(-0.1, 0.1, (V, d9)), jnp.float32))
+    accv = jnp.full((V, d9), 0.1, jnp.float32)
+    k2t = jax.jit(lambda tt, at, i, g: k2t_apply(
+        tt, at, i, g, lr=0.05, eps=1e-7))
+    try:
+        # Correctness vs the scatter reference (transposed back).
+        if jax.default_backend() == "cpu":
+            # Interpret mode runs the grid in Python: tiny shapes only.
+            vs, ns = 4096, 2048
+            tbs = jnp.asarray(rng.uniform(-0.1, 0.1, (vs, d9)), jnp.float32)
+            acs = jnp.full((vs, d9), 0.1, jnp.float32)
+            idss = jnp.asarray(rng.integers(0, vs, (ns,)), jnp.int32)
+            gs = jnp.asarray(
+                rng.uniform(-1e-2, 1e-2, (ns, d9)), jnp.float32)
+            t_t, a_t = k2t(tbs.T, acs.T, idss, gs)
+            a_ref2 = acs.at[idss].add(gs * gs)
+            t_ref2 = tbs.at[idss].add(
+                -0.05 * gs * jax.lax.rsqrt(a_ref2[idss] + 1e-7))
+            errt = float(jnp.max(jnp.abs(t_t.T - t_ref2)))
+            print(f"  K2-transposed parity err {errt:.2e} (interpret, "
+                  f"V={vs} n={ns})", flush=True)
+        else:
+            t_t, a_t = k2t(tbl.T, accv.T, ids, gk)
+            a_ref2 = accv.at[ids].add(gk * gk)
+            t_ref2 = tbl.at[ids].add(
+                -0.05 * gk * jax.lax.rsqrt(a_ref2[ids] + 1e-7))
+            errt = float(jnp.max(jnp.abs(t_t.T - t_ref2)))
+            ms_t = bench(k2t, tbl.T, accv.T, ids, gk)
+            prod = jax.jit(lambda tb, a, i, g: sa.adagrad_apply(
+                tb, a, i, g, lr=0.05, eps=1e-7))
+            ms_p = bench(prod, tbl, accv, ids, gk)
+            print(
+                f"  K2 transposed [9,V]: {ms_t:7.3f} ms vs production "
+                f"[V,9]: {ms_p:7.3f} ms (parity err {errt:.2e})",
+                flush=True)
+        del t_t, a_t
+    except Exception as exc:  # noqa: BLE001 — a probe must not die here
+        print(f"  K2-transposed probe FAILED: {type(exc).__name__}: "
+              f"{str(exc).splitlines()[0][:140]}", flush=True)
+    del gk, tbl, accv
+
     # ---- cumsum variants ---------------------------------------------
     flags = jax.device_put(
         jnp.asarray(rng.integers(0, 2, (N,)), jnp.int32))
