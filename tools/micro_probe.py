@@ -32,6 +32,85 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from timing import bench, drain  # noqa: E402
 
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+from functools import partial as _partial
+
+import jax.experimental.pallas as pl
+import jax.experimental.pallas.tpu as pltpu
+
+from fast_tffm_tpu.ops import sparse_apply as sa
+
+def _k2t_kernel(ts_ref, table_ref, acc_ref, u_hbm_ref, table_out_ref,
+                acc_out_ref, u_vmem, sem, *, tile, group, d, lr, eps):
+    base = pl.program_id(0) * group
+
+    def window(j, slot):
+        start = ts_ref[base + j]
+        return pltpu.make_async_copy(
+            u_hbm_ref.at[pl.ds(start, tile)], u_vmem.at[slot],
+            sem.at[slot],
+        )
+
+    window(0, 0).start()
+    for j in range(group):
+        slot = j % 2
+        if j + 1 < group:
+            window(j + 1, (j + 1) % 2).start()
+        window(j, slot).wait()
+        start = ts_ref[base + j]
+        cnt = ts_ref[base + j + 1] - start
+        u = u_vmem[slot]  # [R, L]
+        e_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
+        u = jnp.where(e_iota < cnt, u, 0.0)
+        lrow = u[:, 2 * d:2 * d + 1].astype(jnp.int32)
+        r_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
+        p = ((lrow == r_iota) & (e_iota < cnt)).astype(jnp.bfloat16)
+        u_hi = u.astype(jnp.bfloat16)
+        u_lo = (u - u_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        dn = (((0,), (0,)), ((), ()))  # contract entries -> [L, R]
+        dense_t = (
+            jax.lax.dot_general(u_hi, p, dn,
+                                preferred_element_type=jnp.float32)
+            + jax.lax.dot_general(u_lo, p, dn,
+                                  preferred_element_type=jnp.float32)
+        )
+        g1t = dense_t[:d, :]  # [D, R]
+        g2t = dense_t[d:2 * d, :]
+        cols = pl.ds(j * tile, tile)
+        acc_new = acc_ref[:, cols] + g2t
+        table_out_ref[:, cols] = table_ref[:, cols] - lr * g1t * (
+            jax.lax.rsqrt(acc_new + eps))
+        acc_out_ref[:, cols] = acc_new
+
+def k2t_apply(table_t, acc_t, ids_, g_rows, *, lr, eps):
+    vocab = table_t.shape[1]
+    d = table_t.shape[0]
+    u, tile_start = sa._dedup_and_starts(ids_, g_rows, vocab)
+    tile, group = sa.TILE, sa._group_for(vocab // sa.TILE)
+    block = tile * group
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(vocab // block,),
+        in_specs=[pl.BlockSpec((d, block), lambda t, *_: (0, t))] * 2
+        + [pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec((d, block), lambda t, *_: (0, t))] * 2,
+        scratch_shapes=[
+            pltpu.VMEM((2, tile, u.shape[1]), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _partial(_k2t_kernel, tile=tile, group=group, d=d, lr=lr,
+                 eps=eps),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((d, vocab), jnp.float32)] * 2,
+        input_output_aliases={1: 0, 2: 1},
+        interpret=jax.default_backend() == "cpu",
+    )(tile_start, table_t, acc_t, u)
+
 
 def main() -> int:
     import jax
@@ -213,81 +292,6 @@ def main() -> int:
     # 9->16, only ~1.8x) with the placement matmul transposed to match.
     # If it wins by the traffic ratio, the table-layout redesign is
     # justified; adagrad only, same windowed u stream as production K2.
-    from functools import partial as _partial
-
-    import jax.experimental.pallas as pl
-    import jax.experimental.pallas.tpu as pltpu
-
-    from fast_tffm_tpu.ops import sparse_apply as sa
-
-    def _k2t_kernel(ts_ref, table_ref, acc_ref, u_hbm_ref, table_out_ref,
-                    acc_out_ref, u_vmem, sem, *, tile, group, d, lr, eps):
-        base = pl.program_id(0) * group
-
-        def window(j, slot):
-            start = ts_ref[base + j]
-            return pltpu.make_async_copy(
-                u_hbm_ref.at[pl.ds(start, tile)], u_vmem.at[slot],
-                sem.at[slot],
-            )
-
-        window(0, 0).start()
-        for j in range(group):
-            slot = j % 2
-            if j + 1 < group:
-                window(j + 1, (j + 1) % 2).start()
-            window(j, slot).wait()
-            start = ts_ref[base + j]
-            cnt = ts_ref[base + j + 1] - start
-            u = u_vmem[slot]  # [R, L]
-            e_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
-            u = jnp.where(e_iota < cnt, u, 0.0)
-            lrow = u[:, 2 * d:2 * d + 1].astype(jnp.int32)
-            r_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
-            p = ((lrow == r_iota) & (e_iota < cnt)).astype(jnp.bfloat16)
-            u_hi = u.astype(jnp.bfloat16)
-            u_lo = (u - u_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-            dn = (((0,), (0,)), ((), ()))  # contract entries -> [L, R]
-            dense_t = (
-                jax.lax.dot_general(u_hi, p, dn,
-                                    preferred_element_type=jnp.float32)
-                + jax.lax.dot_general(u_lo, p, dn,
-                                      preferred_element_type=jnp.float32)
-            )
-            g1t = dense_t[:d, :]  # [D, R]
-            g2t = dense_t[d:2 * d, :]
-            cols = pl.ds(j * tile, tile)
-            acc_new = acc_ref[:, cols] + g2t
-            table_out_ref[:, cols] = table_ref[:, cols] - lr * g1t * (
-                jax.lax.rsqrt(acc_new + eps))
-            acc_out_ref[:, cols] = acc_new
-
-    def k2t_apply(table_t, acc_t, ids_, g_rows, *, lr, eps):
-        vocab = table_t.shape[1]
-        d = table_t.shape[0]
-        u, tile_start = sa._dedup_and_starts(ids_, g_rows, vocab)
-        tile, group = sa.TILE, sa._group_for(vocab // sa.TILE)
-        block = tile * group
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(vocab // block,),
-            in_specs=[pl.BlockSpec((d, block), lambda t, *_: (0, t))] * 2
-            + [pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=[pl.BlockSpec((d, block), lambda t, *_: (0, t))] * 2,
-            scratch_shapes=[
-                pltpu.VMEM((2, tile, u.shape[1]), jnp.float32),
-                pltpu.SemaphoreType.DMA((2,)),
-            ],
-        )
-        return pl.pallas_call(
-            _partial(_k2t_kernel, tile=tile, group=group, d=d, lr=lr,
-                     eps=eps),
-            grid_spec=grid_spec,
-            out_shape=[jax.ShapeDtypeStruct((d, vocab), jnp.float32)] * 2,
-            input_output_aliases={1: 0, 2: 1},
-            interpret=jax.default_backend() == "cpu",
-        )(tile_start, table_t, acc_t, u)
-
     d9 = 9
     gk = jax.device_put(
         jnp.asarray(rng.uniform(-1e-2, 1e-2, (N, d9)), jnp.float32))
