@@ -508,8 +508,17 @@ int64_t fm_sort_meta(const int32_t* ids, int64_t n, int64_t n_pad,
       (static_cast<int64_t>(vocab) >> lo_bits) + 1;  // top-bits range
   std::vector<uint64_t> key(n_pad), key2(n_pad);
   for (int64_t i = 0; i < n_pad; ++i) {
-    const uint64_t id = i < n ? static_cast<uint32_t>(ids[i])
-                              : static_cast<uint64_t>(vocab);
+    uint64_t id = static_cast<uint64_t>(vocab);  // sentinel for the pad tail
+    if (i < n) {
+      // Fail loud on out-of-range ids (matching the argument checks
+      // above): a negative id cast to unsigned, or id >= vocab, would
+      // index the bucket histogram/scatter out of bounds — heap
+      // corruption, not just a wrong answer.  Callers fall back to the
+      // always-correct device sort on -1.
+      const int32_t v = ids[i];
+      if (v < 0 || v >= vocab) return -1;
+      id = static_cast<uint32_t>(v);
+    }
     key[i] = (id << kIdxBits) | static_cast<uint64_t>(i);
   }
   // Pass A+B: bucket histogram over the top id bits, then scatter.

@@ -141,8 +141,8 @@ def sort_meta(ids, vocab: int, chunk: int, tile: int):
     )
     if rc < 0:
         raise ValueError(
-            f"fm_sort_meta rejected arguments: n={n} vocab={vocab} "
-            f"chunk={chunk} tile={tile}"
+            f"fm_sort_meta rejected arguments or out-of-range ids: n={n} "
+            f"vocab={vocab} chunk={chunk} tile={tile}"
         )
     return SortMeta(perm, upos, lrow_last, starts, firsts, ends, tile_start)
 

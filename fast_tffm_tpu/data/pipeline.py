@@ -294,6 +294,7 @@ class BatchPipeline:
         self._sort_meta_spec = (
             sort_meta_spec if self._native is not None else None
         )
+        self._sort_meta_warned = False
         # Fast ingest: raw binary chunks + C++ line scan, no Python string
         # per line. Requires the native parser; weight_files need per-line
         # pairing so they stay on the line path. Shuffling permutes LINES
@@ -428,9 +429,32 @@ class BatchPipeline:
                     if self._sort_meta_spec is not None:
                         from fast_tffm_tpu.data import native as _native
 
-                        batch = batch._replace(sort_meta=_native.sort_meta(
-                            batch.ids, *self._sort_meta_spec
-                        ))
+                        # Metadata is an optimization, not a correctness
+                        # requirement: the device-sort path handles
+                        # sort_meta=None.  A native failure here must
+                        # degrade, not kill the epoch — same contract as
+                        # Trainer._put's fallback, including disabling
+                        # the spec so later batches skip the doomed call.
+                        try:
+                            batch = batch._replace(
+                                sort_meta=_native.sort_meta(
+                                    batch.ids, *self._sort_meta_spec
+                                )
+                            )
+                        except Exception as e:
+                            self._sort_meta_spec = None
+                            if not self._sort_meta_warned:
+                                self._sort_meta_warned = True
+                                log.warning(
+                                    "host sort_meta failed (%s: %s); "
+                                    "falling back to device sort for the "
+                                    "rest of the run.  If the error names "
+                                    "out-of-range ids, the input data or "
+                                    "vocabulary_size is wrong — the device "
+                                    "path will silently drop updates for "
+                                    "ids >= vocabulary_size.",
+                                    type(e).__name__, e,
+                                )
                 except BaseException as e:
                     put_checked(out, _Error(e))
                     continue

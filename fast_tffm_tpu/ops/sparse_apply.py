@@ -47,8 +47,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 # Block sizes, overridable via env for hardware tuning (the grid-overhead
 # vs MXU-work tradeoff is a chip property; tools/tpu_validate.py
-# --sweep-blocks measures it).  Both must be multiples of 8 (sublanes);
-# TILE additionally gates supports_tile's vocab-divisibility check.
+# --sweep-blocks measures it).  Only CHUNK and TILE must themselves be
+# multiples of 8 (sublanes).  GROUP is a plain loop trip count;
+# K1_GROUP does scale a tiled dimension ([CHUNK*K1_GROUP, lanes] payload
+# blocks — see its comment below) but needs no own multiple because
+# CHUNK keeps the product sublane-aligned.  TILE additionally gates
+# supports_tile's vocab-divisibility check.
 def _env_block(name: str, default: int, multiple: int = 8) -> int:
     raw = os.environ.get(name, str(default))
     try:

@@ -207,3 +207,46 @@ def test_pipeline_workers_attach_meta(tmp_path):
     b = batches[0]
     dev = _device_meta(b.ids.reshape(-1), V)
     np.testing.assert_array_equal(b.sort_meta.perm, dev["perm"])
+
+
+@pytest.mark.parametrize("bad_id", [-1, V, V + 17, np.iinfo(np.int32).min])
+def test_sort_meta_rejects_out_of_range_ids(bad_id):
+    """An id outside [0, vocab) must fail loud (-1 -> ValueError), never
+    index the native histogram/scatter out of bounds.  The normal parser
+    mods ids into range, but sort_meta is also called on arbitrary
+    Batch.ids via Trainer._put."""
+    ids = _ids(2, 1024)
+    ids[37] = bad_id
+    with pytest.raises(ValueError, match="out-of-range"):
+        native.sort_meta(ids, V, sparse_apply.CHUNK, sparse_apply.TILE)
+
+
+def test_pipeline_worker_sort_meta_failure_degrades(tmp_path, monkeypatch):
+    """A sort_meta failure inside a pipeline worker must degrade to the
+    device-sort path (sort_meta=None + one warning), not kill the epoch —
+    the same contract Trainer._put documents for its own fallback."""
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.data import native as native_mod
+    from fast_tffm_tpu.data.pipeline import BatchPipeline
+
+    path = tmp_path / "data.libsvm"
+    rng = np.random.default_rng(1)
+    lines = [
+        "1 " + " ".join(f"{rng.integers(0, V)}:0.5" for _ in range(4))
+        for _ in range(32)
+    ]
+    path.write_text("\n".join(lines) + "\n")
+    cfg = FmConfig(
+        vocabulary_size=V, factor_num=D - 1, max_features=8, batch_size=16,
+    )
+
+    def boom(*a, **kw):
+        raise ValueError("injected sort_meta failure")
+
+    monkeypatch.setattr(native_mod, "sort_meta", boom)
+    spec = (V, sparse_apply.CHUNK, sparse_apply.TILE)
+    batches = list(BatchPipeline(
+        [str(path)], cfg, epochs=1, shuffle=False, sort_meta_spec=spec
+    ))
+    assert len(batches) == 2  # the epoch completed
+    assert all(b.sort_meta is None for b in batches)
