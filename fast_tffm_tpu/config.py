@@ -77,6 +77,12 @@ class FmConfig:
     # Input-pipeline knobs (reference queue knobs, SURVEY.md §2 #6).
     thread_num: int = 4
     queue_size: int = 64
+    # Kept for config compatibility: the reference ran N shuffle-queue
+    # threads between its reader and parser queues.  Here shuffling is a
+    # window permutation inside the (single, sequential-IO) reader thread
+    # — it costs one rng permutation per window, so there is nothing to
+    # parallelize; parsing parallelism is thread_num.  Accepted and
+    # ignored, like vocabulary_block_num.
     shuffle_threads: int = 1
     shuffle_buffer: int = 10000
     save_steps: int = 0  # 0 = only at end of training
@@ -142,6 +148,14 @@ class FmConfig:
     # (sparse-friendly); "full" regularizes the whole table (dense grads,
     # only sane for small vocabularies).
     l2_mode: str = "batch"
+    # How the shardmap step exchanges sparse updates over the data axis
+    # (the reference's IndexedSlices push, SURVEY.md §3.2): "dense" psums
+    # a [vocab_local, 2D] delta (O(vocab), simple, best at small vocab /
+    # large batch); "entries" all-gathers only the deduped touched-row
+    # entry streams (batch-proportional, vocab-independent — the scaling
+    # property the reference's PS push had); "auto" picks whichever moves
+    # fewer bytes for the static shapes.
+    sparse_exchange: str = "auto"
 
     def __post_init__(self) -> None:
         if self.vocabulary_size <= 0:
@@ -158,6 +172,10 @@ class FmConfig:
             raise ValueError(f"unknown l2_mode {self.l2_mode!r}")
         if self.sparse_apply not in ("auto", "tile", "scatter"):
             raise ValueError(f"unknown sparse_apply {self.sparse_apply!r}")
+        if self.sparse_exchange not in ("auto", "dense", "entries"):
+            raise ValueError(
+                f"unknown sparse_exchange {self.sparse_exchange!r}"
+            )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.interaction not in ("", "pallas", "jnp", "flat"):
@@ -246,6 +264,7 @@ _KEYMAP = {
     "fast_ingest": ("fast_ingest", _parse_bool),
     "host_sort": ("host_sort", _parse_bool),
     "l2_mode": ("l2_mode", str),
+    "sparse_exchange": ("sparse_exchange", str),
 }
 
 

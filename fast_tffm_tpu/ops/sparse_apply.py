@@ -273,7 +273,8 @@ def _group_for(n_tiles: int, want: int | None = None) -> int:
     return group
 
 
-def _window_loop(ts_ref, u_hbm_ref, u_vmem, sem, *, tile, group, d, body):
+def _window_loop(ts_ref, u_hbm_ref, u_vmem, sem, *, tile, group, d, body,
+                 base=None):
     """Double-buffered subtile loop shared by K2 and K-place.
 
     Walks ``group`` subtiles, DMA-ing each one's entry window while the
@@ -281,8 +282,12 @@ def _window_loop(ts_ref, u_hbm_ref, u_vmem, sem, *, tile, group, d, body):
     flight during subtile j's compute), and calls ``body(j, g1, g2)``
     with the placed per-row sums.  This is the one copy of the
     slot/semaphore rotation protocol — keep it that way.
+
+    ``base`` is the first subtile's global index (defaults to the grid
+    position; the compact K2 variant passes the remapped group index).
     """
-    base = pl.program_id(0) * group
+    if base is None:
+        base = pl.program_id(0) * group
 
     def window(j, slot):
         start = ts_ref[base + j]
@@ -307,6 +312,21 @@ def _k2_group_kernel(ts_ref, *args, n_tables, tile, group, d, update):
     ``update(g1, g2, *table_slices) -> new_table_slices`` is one of the
     shared elementwise optimizer formulas (adagrad_update/...).
     """
+    _k2_body(ts_ref, None, args, n_tables, tile, group, d, update)
+
+
+def _k2_group_kernel_compact(ts_ref, cg_ref, *args, n_tables, tile, group,
+                             d, update):
+    """Compact K2 body: grid step t works on group ``cg_ref[t]`` instead
+    of group t — the BlockSpec index_maps use the same remapping, so the
+    table blocks arriving in VMEM match the entry windows."""
+    _k2_body(
+        ts_ref, cg_ref[pl.program_id(0)] * group, args, n_tables, tile,
+        group, d, update,
+    )
+
+
+def _k2_body(ts_ref, base, args, n_tables, tile, group, d, update):
     ins = args[:n_tables]
     u_hbm_ref = args[n_tables]
     outs = args[n_tables + 1:2 * n_tables + 1]
@@ -320,40 +340,110 @@ def _k2_group_kernel(ts_ref, *args, n_tables, tile, group, d, update):
 
     _window_loop(
         ts_ref, u_hbm_ref, u_vmem, sem, tile=tile, group=group, d=d,
-        body=body,
+        body=body, base=base,
     )
 
 
-def _k2_call(update, tile_start, u, tables, lanes):
-    """Stream ``tables`` (tuple) through the grouped K2 apply kernel."""
+def _compact_auto(n_entries: int, n_groups: int) -> bool:
+    """Auto-engage compact K2 only when the entry count bounds touched
+    groups to <= half the table's groups — streaming the whole table is
+    faster when most blocks are touched anyway (no remap indirection,
+    denser pipelining)."""
+    return 2 * min(n_entries, n_groups) <= n_groups
+
+
+def _compact_groups(tile_start, n_groups, group, t_max):
+    """Indices of the touched tile-groups, padded to static length t_max.
+
+    ``comp[j]`` is the group index the j-th grid step should process:
+    the j-th touched group for j < touched-count, then (padding) the
+    FIRST UNTOUCHED group.  The filler must be untouched — revisiting a
+    touched group would re-apply its update — and identical across all
+    filler steps (consecutive same-block revisits are the pipeline
+    pattern BlockSpecs handle); an untouched group's update is the
+    identity, so rewriting it any number of times is safe.  When every
+    group is touched (only possible when t_max == n_groups) there are no
+    filler steps, so the clamped fallback index is never used.
+    """
+    ts_g = tile_start[::group]  # [n_groups + 1] entry offsets per group
+    touched = (ts_g[1:] > ts_g[:-1]).astype(jnp.int32)
+    c = _cumsum_counts(touched)  # inclusive: c[gi] = touched in [0, gi]
+    count = c[-1]
+    j = jnp.arange(t_max, dtype=jnp.int32)
+    comp = jnp.searchsorted(c, jnp.minimum(j + 1, count)).astype(jnp.int32)
+    un_c = jnp.arange(1, n_groups + 1, dtype=c.dtype) - c  # untouched cum.
+    first_un = jnp.minimum(
+        jnp.searchsorted(un_c, 1).astype(jnp.int32), n_groups - 1
+    )
+    return jnp.where(j < count, comp, first_un)
+
+
+def _k2_call(update, tile_start, u, tables, lanes, compact=None):
+    """Stream ``tables`` (tuple) through the grouped K2 apply kernel.
+
+    ``compact``: None = static auto-decision, True/False = force.  The
+    compact variant visits only tile-groups the entry stream touches
+    (via a scalar-prefetched group list driving the BlockSpec index
+    maps); unvisited blocks are never fetched or written — their rows
+    survive through the input/output aliasing.  HBM streaming then
+    scales with min(touched groups, V/block) instead of V — the
+    IndexedSlices property (SURVEY.md §3.2) for the apply's table
+    traffic.  Only engaged when the entry count bounds touched groups
+    to <= half the table (streaming the whole table is faster when most
+    blocks are touched anyway).
+    """
     v, d = tables[0].shape
     tile = TILE
     group = _group_for(v // tile)
     n_arrays = len(tables)
     block = tile * group
+    n_groups = v // block
+    n_entries = u.shape[0] - tile  # stream length minus window slack
+    t_max = min(n_groups, n_entries)
+    if compact is None:
+        compact = _compact_auto(n_entries, n_groups)
+    if compact:
+        comp = _compact_groups(tile_start, n_groups, group, t_max)
+        grid = (t_max,)
+        num_prefetch = 2
+        # index_map args: (grid idx, tile_start ref, compact ref).
+        block_index = lambda t, ts, cg: (cg[t], 0)  # noqa: E731
+        kernel = functools.partial(
+            _k2_group_kernel_compact, n_tables=n_arrays, tile=tile,
+            group=group, d=d, update=update,
+        )
+        prefetch_args = (tile_start, comp)
+    else:
+        grid = (n_groups,)
+        num_prefetch = 1
+        block_index = lambda t, *_: (t, 0)  # noqa: E731
+        kernel = functools.partial(
+            _k2_group_kernel, n_tables=n_arrays, tile=tile, group=group,
+            d=d, update=update,
+        )
+        prefetch_args = (tile_start,)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(v // block,),
-        in_specs=[pl.BlockSpec((block, d), lambda t, *_: (t, 0))] * n_arrays
+        num_scalar_prefetch=num_prefetch,
+        grid=grid,
+        in_specs=[pl.BlockSpec((block, d), block_index)] * n_arrays
         + [pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=[pl.BlockSpec((block, d), lambda t, *_: (t, 0))] * n_arrays,
+        out_specs=[pl.BlockSpec((block, d), block_index)] * n_arrays,
         scratch_shapes=[
             pltpu.VMEM((2, tile, lanes), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     return pl.pallas_call(
-        functools.partial(
-            _k2_group_kernel, n_tables=n_arrays, tile=tile, group=group,
-            d=d, update=update,
-        ),
+        kernel,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((v, d), jnp.float32) for _ in range(n_arrays)
         ],
-        input_output_aliases={1 + i: i for i in range(n_arrays)},
+        input_output_aliases={
+            num_prefetch + i: i for i in range(n_arrays)
+        },
         interpret=_use_interpret(),
-    )(tile_start, *tables, u)
+    )(*prefetch_args, *tables, u)
 
 
 # ------------------------------------------------- K-place: dense expansion
@@ -417,6 +507,88 @@ def dense_delta(ids, g_rows, *, vocab, vocab_local, row_lo):
     return _kplace_call(tile_start, u, vocab_local, d, u.shape[1])
 
 
+# ------------------------------------------- entries exchange (sharded path)
+
+
+def entries_cap(n_occurrences: int, vocab: int) -> int:
+    """Static per-shard entry-stream capacity for the entries exchange.
+
+    Exact worst case — unique touched rows can't exceed the occurrence
+    count (CHUNK-padded, the stream's real-entry bound) or the vocab
+    range (CHUNK-rounded so the merged stream stays CHUNK-divisible).
+    Always-correct by construction: no overflow path exists.
+    """
+    n_pad = -(-n_occurrences // CHUNK) * CHUNK
+    return min(n_pad, -(-vocab // CHUNK) * CHUNK)
+
+
+def unique_entries(ids, g_rows, *, vocab, cap):
+    """Deduped touched-row entry stream: (rows [cap] i32, pay [cap, 2D]
+    f32, count).
+
+    The batch-proportional half of the reference's IndexedSlices push
+    (SURVEY.md §3.2): instead of a dense [vocab, 2D] delta, emit only
+    the rows the batch touched — sorted, deduped (sum g / sum g² per
+    row), sentinel-padded (row == vocab, zero payload) to the static
+    ``cap``.  Rows are recovered exactly from the K1 stream's
+    lrow/tidx metadata columns (integer-valued f32, exact — see _prep).
+    """
+    d = g_rows.shape[1]
+    payload, upos, starts, firsts, ends, sidx, n_pad = _prep(
+        ids, g_rows, vocab
+    )
+    if cap > n_pad:
+        raise ValueError(f"cap={cap} exceeds padded occurrences {n_pad}")
+    u = _k1_dedup(payload, upos, starts, firsts, ends, n_pad + TILE)
+    count = _tile_starts(
+        sidx, upos, jnp.full((1,), vocab, sidx.dtype)
+    )[0]  # uniques among real (non-sentinel) rows
+    valid = jnp.arange(cap, dtype=jnp.int32) < count
+    lrow = u[:cap, 2 * d].astype(jnp.int32)
+    tidx = u[:cap, 2 * d + 1].astype(jnp.int32)
+    rows = jnp.where(valid, tidx * TILE + lrow, vocab)
+    pay = jnp.where(valid[:, None], u[:cap, :2 * d], 0.0)
+    return rows, pay, count
+
+
+def merge_entries(rows, pay, *, vocab):
+    """Merge concatenated per-shard entry streams into one K2-ready
+    stream: (u [N+TILE, 128], tile_start).
+
+    Each source stream is already deduped, so a row appears at most once
+    per shard; the merge re-sorts the concatenation and K1 sums the <=S
+    partial (sum g, sum g²) contributions per row — totals identical to
+    the dense psum's, so the downstream optimizer math is unchanged.
+    Sentinel entries (row == vocab) sort last and fall outside
+    tile_start's coverage.
+    """
+    n = rows.shape[0]
+    if n % CHUNK:
+        raise ValueError(f"merged stream length {n} not a CHUNK multiple")
+    sidx, perm = jax.lax.sort_key_val(rows, jnp.arange(n, dtype=jnp.int32))
+    pay_sorted = pay[perm]
+    upos, last, starts, firsts, ends = _sorted_stream_meta(sidx)
+    lrow = (sidx % TILE).astype(jnp.float32)
+    # pay already holds (sum g, sum g²) — concatenate the placement
+    # metadata column instead of re-deriving squares (_payload would
+    # square the partial sums).
+    payload = _pad_lanes(
+        jnp.concatenate([pay_sorted, (lrow * last)[:, None]], axis=1)
+    )
+    u = _k1_dedup(payload, upos, starts, firsts, ends, n + TILE)
+    tile_start = _tile_starts(
+        sidx, upos, jnp.arange(0, vocab + 1, TILE, dtype=sidx.dtype)
+    )
+    return u, tile_start
+
+
+def k2_apply(update, tile_start, u, tables, compact=None):
+    """Apply an elementwise optimizer ``update`` from a K2-ready entry
+    stream (as produced by merge_entries) to ``tables``."""
+    return _k2_call(update, tile_start, u, tables, u.shape[1],
+                    compact=compact)
+
+
 # ------------------------------------------------------------ orchestration
 
 
@@ -455,30 +627,54 @@ def _cumsum_counts(flags):
     return (within + offs[:, None]).reshape(n).astype(flags.dtype)
 
 
-def _payload(g_sorted, lrow_last):
-    """[g | g^2 | lrow·last] per sorted occurrence, 128-lane padded.
+def _pad_lanes(x):
+    """Pad the minor dim to the 128-lane tile.
 
-    The minor dim is padded to the 128-lane tile: the unique-entry stream
-    this payload becomes is DMA'd at dynamic offsets (K1 out, K2/K-place
-    in), and Mosaic requires manually sliced HBM memrefs to be
-    lane-aligned ("Slice shape along dimension 1 must be aligned to
+    The unique-entry stream is DMA'd at dynamic offsets (K1 out,
+    K2/K-place in), and Mosaic requires manually sliced HBM memrefs to
+    be lane-aligned ("Slice shape along dimension 1 must be aligned to
     tiling (128)" on real v5e — auto-pipelined BlockSpecs pad for free,
     manual `.at[pl.ds(...)]` copies do not).  HBM storage is already
     physically padded to 128 lanes by tiling, so the zeros cost no extra
     traffic.
     """
-    n_pad = g_sorted.shape[0]
-    payload = jnp.concatenate(
-        [g_sorted, g_sorted * g_sorted, lrow_last[:, None]], axis=1
-    )  # [N, 2D+1]
-    lanes = payload.shape[1]
+    n, lanes = x.shape
     lanes_pad = -(-lanes // 128) * 128
     if lanes_pad != lanes:
-        payload = jnp.concatenate(
-            [payload, jnp.zeros((n_pad, lanes_pad - lanes), payload.dtype)],
-            axis=1,
-        )  # [N, lanes_pad]
-    return payload
+        x = jnp.concatenate(
+            [x, jnp.zeros((n, lanes_pad - lanes), x.dtype)], axis=1
+        )
+    return x
+
+
+def _payload(g_sorted, lrow_last, tidx_last=None):
+    """[g | g^2 | lrow·last | tidx·last?] per sorted occurrence, 128-lane
+    padded (see _pad_lanes).
+
+    ``tidx_last`` (the occurrence's tile index, · last-in-segment flag)
+    is carried only where the deduped stream's global rows must be
+    recoverable afterwards — the entries exchange.  Like lrow, K1's
+    segment sum leaves exactly the value on the unique entry because
+    only the last occurrence contributes.
+    """
+    cols = [g_sorted, g_sorted * g_sorted, lrow_last[:, None]]
+    if tidx_last is not None:
+        cols.append(tidx_last[:, None])
+    return _pad_lanes(jnp.concatenate(cols, axis=1))
+
+
+def _sorted_stream_meta(sidx):
+    """Segment metadata for a sorted id stream: (upos, last-flags, and the
+    K1 chunk-boundary scalars).  Shared by _prep and merge_entries."""
+    flag_first = jnp.concatenate([jnp.full((1,), -1, sidx.dtype), sidx[:-1]])
+    flags = (sidx != flag_first).astype(jnp.int32)  # segment starts
+    upos = _cumsum_counts(flags) - 1  # unique-row position per occurrence
+    nxt = jnp.concatenate([sidx[1:], jnp.full((1,), -2, sidx.dtype)])
+    last = (sidx != nxt).astype(jnp.float32)  # segment ends
+    starts = upos[::CHUNK]
+    firsts = jnp.concatenate([flags[::CHUNK], jnp.ones((1,), jnp.int32)])
+    ends = upos[CHUNK - 1::CHUNK]
+    return upos, last, starts, firsts, ends
 
 
 def _prep(ids, g_rows, vocab):
@@ -497,16 +693,12 @@ def _prep(ids, g_rows, vocab):
         )
     sidx, perm = jax.lax.sort_key_val(ids, jnp.arange(n_pad, dtype=jnp.int32))
     g_sorted = g_rows[perm]
-    prev = jnp.concatenate([jnp.full((1,), -1, sidx.dtype), sidx[:-1]])
-    flags = (sidx != prev).astype(jnp.int32)  # segment starts
-    upos = _cumsum_counts(flags) - 1  # unique-row position per occurrence
-    nxt = jnp.concatenate([sidx[1:], jnp.full((1,), -2, sidx.dtype)])
-    last = (sidx != nxt).astype(jnp.float32)  # segment ends
+    upos, last, starts, firsts, ends = _sorted_stream_meta(sidx)
     lrow = (sidx % TILE).astype(jnp.float32)  # tile-local row, exact < TILE
-    payload = _payload(g_sorted, lrow * last)
-    starts = upos[::CHUNK]
-    firsts = jnp.concatenate([flags[::CHUNK], jnp.ones((1,), jnp.int32)])
-    ends = upos[CHUNK - 1::CHUNK]
+    # Tile index, f32-exact while vocab/TILE < 2^24 (true for any vocab
+    # < 2^31 at TILE >= 256 — int32 ids cap vocab below that anyway).
+    tidx = (sidx // TILE).astype(jnp.float32)
+    payload = _payload(g_sorted, lrow * last, tidx * last)
     return payload, upos, starts, firsts, ends, sidx, n_pad
 
 
@@ -549,31 +741,40 @@ def _dedup_and_starts(ids, g_rows, vocab, meta=None):
     return u, tile_start
 
 
-def adagrad_apply(table, acc, ids, g_rows, *, lr, eps, meta=None):
+def adagrad_apply(table, acc, ids, g_rows, *, lr, eps, meta=None,
+                  compact=None):
     """Sparse Adagrad over touched rows: exact SparseApplyAdagrad semantics."""
     vocab, d = table.shape
     u, tile_start = _dedup_and_starts(ids, g_rows, vocab, meta)
     update = functools.partial(adagrad_update, lr=lr, eps=eps)
-    table, acc = _k2_call(update, tile_start, u, (table, acc), u.shape[1])
+    table, acc = _k2_call(update, tile_start, u, (table, acc), u.shape[1],
+                          compact=compact)
     return table, acc
 
 
-def sgd_apply(table, ids, g_rows, *, lr, meta=None):
+def sgd_apply(table, ids, g_rows, *, lr, meta=None, compact=None):
     vocab, d = table.shape
     u, tile_start = _dedup_and_starts(ids, g_rows, vocab, meta)
     update = functools.partial(sgd_update, lr=lr)
-    (table,) = _k2_call(update, tile_start, u, (table,), u.shape[1])
+    (table,) = _k2_call(update, tile_start, u, (table,), u.shape[1],
+                        compact=compact)
     return table
 
 
-def ftrl_apply(table, z, n, ids, g_rows, *, lr, l1, l2, beta, meta=None):
+def ftrl_apply(table, z, n, ids, g_rows, *, lr, l1, l2, beta, meta=None,
+               compact=None):
     # Recomputing w for untouched rows inside ftrl_update is idempotent:
     # their (z, n) are unchanged and w is always ftrl_solve(z, n)
-    # (train.sparse initializes z so this holds from step 0).
+    # (train.sparse initializes z so this holds from step 0).  This
+    # invariant is a CONTRACT: the full sweep recomputes every row while
+    # compact K2 skips untouched ones, and the two only agree because
+    # recompute == stored value.  A caller handing in a table that is
+    # not ftrl_solve(z, n) gets sweep-dependent untouched rows.
     vocab, d = table.shape
     u, tile_start = _dedup_and_starts(ids, g_rows, vocab, meta)
     update = functools.partial(ftrl_update, lr=lr, l1=l1, l2=l2, beta=beta)
-    table, z, n = _k2_call(update, tile_start, u, (table, z, n), u.shape[1])
+    table, z, n = _k2_call(update, tile_start, u, (table, z, n), u.shape[1],
+                           compact=compact)
     return table, z, n
 
 

@@ -35,6 +35,8 @@ FM's s1.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -60,6 +62,29 @@ def supports_shardmap(cfg: FmConfig, mesh) -> bool:
     return sparse_apply.supports_tile_sharded(
         cfg.vocabulary_size, cfg.optimizer, model_shards
     )
+
+
+def exchange_mode(cfg: FmConfig, mesh, n_local_occ: int) -> str:
+    """Resolve cfg.sparse_exchange for these static shapes.
+
+    "dense" psums a [vocab_local, 2D] delta over the data axis — bytes
+    grow with vocab, independent of the batch.  "entries" all-gathers
+    the deduped touched-row streams — bytes grow with the batch,
+    independent of vocab (the reference PS design's IndexedSlices
+    scaling, SURVEY.md §3.2).  "auto" picks whichever moves fewer bytes.
+    """
+    if cfg.sparse_exchange != "auto":
+        return cfg.sparse_exchange
+    d = cfg.embedding_dim
+    vocab_local = cfg.vocabulary_size // mesh.shape[MODEL_AXIS]
+    data_shards = mesh.shape[DATA_AXIS]
+    cap = sparse_apply.entries_cap(n_local_occ, vocab_local)
+    # Per-device words received: all-gather of S streams of (row + 2D
+    # payload) vs a [vocab_local, 2D] psum (counted once — psum and
+    # all-gather have comparable per-word ring cost on ICI).
+    entries_words = data_shards * cap * (2 * d + 1)
+    dense_words = vocab_local * 2 * d
+    return "entries" if entries_words < dense_words else "dense"
 
 
 def _dscore(scores, labels, loss_type):
@@ -105,6 +130,8 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
     vocab_local = cfg.vocabulary_size // model_shards
     k = cfg.factor_num
     n_opt = len(_opt_tables(cfg, opt_state))
+    b_local = batch.vals.shape[0] // mesh.shape[DATA_AXIS]
+    exchange = exchange_mode(cfg, mesh, b_local * batch.vals.shape[1])
 
     cd = cfg.compute_jnp_dtype
 
@@ -226,20 +253,43 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
         d = rows.shape[-1]  # 1 + k (FM) or 1 + field_num*k (FFM)
         ids_flat = jnp.where(local, ids - row_lo, vocab_local).reshape(b * f)
         g_flat = drows.reshape(b * f, d)
-        delta = sparse_apply.dense_delta(
-            ids_flat.astype(jnp.int32), g_flat,
-            vocab=vocab_local, vocab_local=vocab_local, row_lo=0,
-        )
-        delta = jax.lax.psum(delta, DATA_AXIS)
+        if exchange == "entries":
+            # Batch-proportional update exchange: dedupe locally, move
+            # only the touched entries over the data axis, merge the S
+            # sorted streams, apply via K2.  Comms are independent of
+            # vocab — the reference's IndexedSlices scaling property.
+            cap = sparse_apply.entries_cap(b * f, vocab_local)
+            rows_e, pay_e, _ = sparse_apply.unique_entries(
+                ids_flat.astype(jnp.int32), g_flat,
+                vocab=vocab_local, cap=cap,
+            )
+            rows_all = jax.lax.all_gather(
+                rows_e, DATA_AXIS, axis=0, tiled=True
+            )
+            pay_all = jax.lax.all_gather(
+                pay_e, DATA_AXIS, axis=0, tiled=True
+            )
+            u2, ts2 = sparse_apply.merge_entries(
+                rows_all, pay_all, vocab=vocab_local
+            )
+            w_new, new_tables = _apply_stream(
+                cfg, ts2, u2, table_l, opt_tables_l
+            )
+        else:
+            delta = sparse_apply.dense_delta(
+                ids_flat.astype(jnp.int32), g_flat,
+                vocab=vocab_local, vocab_local=vocab_local, row_lo=0,
+            )
+            delta = jax.lax.psum(delta, DATA_AXIS)
+            w_new, new_tables = _apply_delta(
+                cfg, delta[:, :d], delta[:, d:], table_l, opt_tables_l
+            )
         dw0 = jax.lax.psum(jnp.sum(g), DATA_AXIS)
         if cfg.bias_lambda:
             # l2_penalty_batch includes bias_lambda*w0^2/B — its w0 grad
             # must land here too or w0 diverges from the scatter path.
             bsz_g = jax.lax.psum(jnp.float32(vals.shape[0]), DATA_AXIS)
             dw0 = dw0 + 2.0 * cfg.bias_lambda * w0 / bsz_g
-        w_new, new_tables = _apply_delta(
-            cfg, delta[:, :d], delta[:, d:], table_l, opt_tables_l
-        )
         return (w_new, scores, dw0) + tuple(new_tables)
 
     out_specs = (
@@ -266,6 +316,35 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
         cfg, opt_state, new_opt_tables, dw0, params.w0
     )
     return fm.FmParams(w0=w0_new, table=table_new), opt_new, scores
+
+
+def _apply_stream(cfg, tile_start, u, w_l, opt_tables_l):
+    """Optimizer update from a merged K2 entry stream (entries exchange).
+
+    Same formulas as _apply_delta, fused in the K2 tile kernel — only
+    streamed/touched tiles are rewritten, so untouched rows pass through
+    by aliasing (bit-identical to the dense path's identity update)."""
+    lr = cfg.learning_rate
+    if cfg.optimizer == "adagrad":
+        upd = functools.partial(
+            sparse_apply.adagrad_update, lr=lr, eps=ADAGRAD_EPS
+        )
+        w_new, acc_new = sparse_apply.k2_apply(
+            upd, tile_start, u, (w_l, opt_tables_l[0])
+        )
+        return w_new, (acc_new,)
+    if cfg.optimizer == "ftrl":
+        upd = functools.partial(
+            sparse_apply.ftrl_update,
+            lr=lr, l1=cfg.ftrl_l1, l2=cfg.ftrl_l2, beta=cfg.ftrl_beta,
+        )
+        w_new, z_new, n_new = sparse_apply.k2_apply(
+            upd, tile_start, u, (w_l,) + tuple(opt_tables_l)
+        )
+        return w_new, (z_new, n_new)
+    upd = functools.partial(sparse_apply.sgd_update, lr=lr)
+    (w_new,) = sparse_apply.k2_apply(upd, tile_start, u, (w_l,))
+    return w_new, ()
 
 
 def _apply_delta(cfg, g1, g2, w_l, opt_tables_l):

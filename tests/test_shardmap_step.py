@@ -241,3 +241,59 @@ def test_shardmap_ffm_with_l2_matches_scatter():
     np.testing.assert_allclose(
         o_sm.acc.table, o_sc.acc.table, rtol=1e-4, atol=1e-5
     )
+
+
+@pytest.mark.parametrize("optimizer", ["adagrad", "ftrl", "sgd"])
+def test_shardmap_entries_exchange_matches_scatter(optimizer):
+    """sparse_exchange=entries (batch-proportional all-gather of touched
+    entries) must reproduce the scatter path like the dense psum does."""
+    mesh = _mesh((4, 2))
+    cfg = FmConfig(
+        vocabulary_size=V, factor_num=K, max_features=8, batch_size=64,
+        optimizer=optimizer, learning_rate=0.05, ftrl_l1=0.01, ftrl_l2=0.1,
+        lookup="shardmap", sparse_exchange="entries",
+    )
+    rng = np.random.default_rng(7)
+    weights = rng.uniform(0.5, 2.0, 64).astype(np.float32)
+    weights[-5:] = 0.0
+    batch = jax.tree.map(jnp.asarray, _batch(5, weights=weights))
+    params = fm.init_params(jax.random.PRNGKey(2), cfg)
+    opt = sparse_lib.init_sparse_opt_state(cfg, params)
+
+    p_sm, o_sm = params, opt
+    step_sm = jax.jit(
+        lambda p, o, b: shardmap_step.sparse_step_shardmap(cfg, p, o, b, mesh)
+    )
+    for _ in range(3):
+        p_sm, o_sm, sm_scores = step_sm(p_sm, o_sm, batch)
+
+    p_sc, o_sc = params, opt
+    step_sc = jax.jit(lambda p, o, b: sparse_lib.sparse_step(cfg, p, o, b))
+    for _ in range(3):
+        p_sc, o_sc, sc_scores = step_sc(p_sc, o_sc, batch)
+
+    np.testing.assert_allclose(sm_scores, sc_scores, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(p_sm.table, p_sc.table, rtol=1e-4, atol=1e-6)
+
+
+def test_shardmap_entries_ffm_matches_scatter():
+    mesh = _mesh((2, 4))
+    p_num = 3
+    cfg = FmConfig(
+        vocabulary_size=V, factor_num=K, max_features=8, batch_size=64,
+        field_num=p_num, optimizer="adagrad", learning_rate=0.05,
+        lookup="shardmap", sparse_exchange="entries",
+    )
+    batch = jax.tree.map(jnp.asarray, _ffm_batch(13, p_num))
+    params = fm.init_params(jax.random.PRNGKey(6), cfg)
+    opt = sparse_lib.init_sparse_opt_state(cfg, params)
+    p_sm, o_sm, _ = jax.jit(
+        lambda p, o, b: shardmap_step.sparse_step_shardmap(cfg, p, o, b, mesh)
+    )(params, opt, batch)
+    p_sc, o_sc, _ = jax.jit(
+        lambda p, o, b: sparse_lib.sparse_step(cfg, p, o, b)
+    )(params, opt, batch)
+    np.testing.assert_allclose(p_sm.table, p_sc.table, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        o_sm.acc.table, o_sc.acc.table, rtol=1e-4, atol=1e-5
+    )

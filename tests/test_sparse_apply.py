@@ -305,3 +305,172 @@ def test_full_sparse_step_sharded_tile():
         results["tile"].table, results["scatter"].table,
         rtol=1e-4, atol=1e-6,
     )
+
+
+# ------------------------- compact K2 (touched-tile streaming) and entries
+
+
+def test_compact_k2_bit_identical_all_optimizers():
+    """Compact K2 (touched-group grid + alias-through) must be
+    bit-identical to the full-streaming K2: the same kernel body runs on
+    the same windows, and unvisited blocks pass through untouched.
+
+    FTRL's table must satisfy the training invariant w == ftrl_solve(z,
+    n) (train.sparse's z-init maintains it from step 0): the full sweep
+    RECOMPUTES w for every row while compact skips untouched rows, so
+    the two agree exactly when — and only when — the invariant holds.
+    This is also why ftrl_apply documents the invariant as a contract.
+    """
+    V_big = 1 << 14  # 64 tiles -> 8 groups: most untouched below
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(
+        np.array([5, 5, 7, 300, 301, 4000] * 40, np.int32)
+    )  # tiles {0, 1, 15} only
+    g = jnp.asarray(rng.uniform(-1, 1, (240, D)).astype(np.float32))
+    table = jnp.asarray(rng.uniform(-0.1, 0.1, (V_big, D)).astype(np.float32))
+    acc = jnp.full((V_big, D), 0.1, jnp.float32)
+    z = jnp.asarray(rng.uniform(-1, 1, (V_big, D)).astype(np.float32))
+    n = jnp.full((V_big, D), 0.5, jnp.float32)
+    lr, l1, l2, beta = 0.1, 0.01, 0.1, 1.0
+    table_f = sparse_apply.ftrl_solve(z, n, lr, l1, l2, beta)  # invariant
+
+    for make in (
+        lambda c: sparse_apply.adagrad_apply(
+            table, acc, ids, g, lr=0.1, eps=1e-7, compact=c
+        ),
+        lambda c: (sparse_apply.sgd_apply(table, ids, g, lr=0.1, compact=c),),
+        lambda c: sparse_apply.ftrl_apply(
+            table_f, z, n, ids, g, lr=lr, l1=l1, l2=l2, beta=beta, compact=c
+        ),
+    ):
+        full = make(False)
+        comp = make(True)
+        for a, b in zip(full, comp):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_compact_groups_mapping():
+    """_compact_groups: touched groups in order, fillers point at an
+    untouched group (re-applying an untouched group is the identity;
+    re-applying a touched one would double-apply)."""
+    group, n_tiles = 2, 8  # 4 groups of 2 tiles
+    # entries: 3 in tile 0, 1 in tile 5 -> groups 0 and 2 touched
+    tile_start = jnp.asarray([0, 3, 3, 3, 3, 3, 4, 4, 4], jnp.int32)
+    comp = np.asarray(sparse_apply._compact_groups(
+        tile_start, n_tiles // group, group, t_max=4
+    ))
+    assert list(comp[:2]) == [0, 2]  # touched, ascending
+    assert all(c == comp[2] for c in comp[2:])  # one filler, repeated
+    assert comp[2] in (1, 3)  # filler untouched
+
+
+def test_compact_heuristic_static():
+    """_compact_auto engages only when entries bound touched groups to
+    <= half the table's groups, and _k2_call's grid obeys the decision
+    (probed from the traced pallas_call grids, like the cost model)."""
+    assert not sparse_apply._compact_auto(n_entries=512, n_groups=8)
+    assert not sparse_apply._compact_auto(n_entries=512, n_groups=1000)
+    assert sparse_apply._compact_auto(n_entries=512, n_groups=1024)
+    assert sparse_apply._compact_auto(n_entries=4, n_groups=8)
+
+    def k2_grids(vocab, n_ids, compact):
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, vocab, n_ids), np.int32
+        )
+        g = jnp.ones((n_ids, D), jnp.float32)
+        table = jnp.zeros((vocab, D), jnp.float32)
+        closed = jax.make_jaxpr(
+            lambda t, i, gg: sparse_apply.sgd_apply(
+                t, i, gg, lr=0.1, compact=compact
+            )
+        )(table, ids, g)
+        grids = set()
+        for j in _walk(closed.jaxpr):
+            for eqn in j.eqns:
+                if eqn.primitive.name == "pallas_call":
+                    gm = eqn.params.get("grid_mapping")
+                    if gm is not None and len(gm.grid) == 1:
+                        grids.add(gm.grid[0])
+        return grids
+
+    def _walk(jaxpr):
+        yield jaxpr
+        for eqn in jaxpr.eqns:
+            for val in eqn.params.values():
+                for v in (val if isinstance(val, (list, tuple)) else (val,)):
+                    inner = getattr(v, "jaxpr", None)
+                    if inner is not None:
+                        yield from _walk(inner)
+                    elif hasattr(v, "eqns"):
+                        yield from _walk(inner if inner else v)
+
+    # auto at V=2^21, 200 ids: n_pad 512 < 1024 groups -> compact grid
+    # (512) present, full-vocab grid (1024) absent; auto at V=2^14 ->
+    # the vocab-bound grid (8) present.
+    group = sparse_apply._group_for((1 << 21) // sparse_apply.TILE)
+    n_groups = (1 << 21) // (sparse_apply.TILE * group)
+    assert 512 in k2_grids(1 << 21, 200, None)
+    assert n_groups not in k2_grids(1 << 21, 200, None)
+    small_groups = (1 << 14) // (sparse_apply.TILE * sparse_apply._group_for(
+        (1 << 14) // sparse_apply.TILE))
+    assert small_groups in k2_grids(1 << 14, 200, None)
+
+
+def test_unique_entries_and_merge_match_dense_delta():
+    """unique_entries -> (gather) -> merge_entries must produce the same
+    per-row (sum g, sum g²) totals as the dense K-place delta."""
+    rng = np.random.default_rng(4)
+    vocab = 2048
+    shards = []
+    cap = sparse_apply.entries_cap(600, vocab)
+    dense_sum = jnp.zeros((vocab, 2 * D), jnp.float32)
+    rows_all, pay_all = [], []
+    for s in range(4):  # simulate 4 data shards
+        ids = rng.integers(0, vocab, 600).astype(np.int32)
+        ids[:100] = 77  # hot id shared across shards
+        g = rng.uniform(-1, 1, (600, D)).astype(np.float32)
+        rows, pay, count = sparse_apply.unique_entries(
+            jnp.asarray(ids), jnp.asarray(g), vocab=vocab, cap=cap
+        )
+        assert int(count) <= cap
+        rows_all.append(rows)
+        pay_all.append(pay)
+        dense_sum = dense_sum + sparse_apply.dense_delta(
+            jnp.asarray(ids), jnp.asarray(g),
+            vocab=vocab, vocab_local=vocab, row_lo=0,
+        )
+    u, ts = sparse_apply.merge_entries(
+        jnp.concatenate(rows_all), jnp.concatenate(pay_all, axis=0),
+        vocab=vocab,
+    )
+    # Apply both deltas with SGD (linear in g1: exposes placement errors).
+    table = jnp.zeros((vocab, D), jnp.float32)
+    (t_entries,) = sparse_apply.k2_apply(
+        __import__("functools").partial(sparse_apply.sgd_update, lr=1.0),
+        ts, u, (table,),
+    )
+    t_dense = -dense_sum[:, :D]
+    np.testing.assert_allclose(
+        np.asarray(t_entries), np.asarray(t_dense), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_unique_entries_sentinel_padding():
+    """Entries beyond the touched count must be sentinels (row == vocab,
+    zero payload) so the merge sorts them out of coverage."""
+    vocab = 2048
+    ids = jnp.asarray(np.array([3, 3, 3, 9], np.int32))
+    g = jnp.ones((4, D), jnp.float32)
+    cap = sparse_apply.entries_cap(4, vocab)
+    rows, pay, count = sparse_apply.unique_entries(
+        ids, g, vocab=vocab, cap=cap
+    )
+    assert int(count) == 2
+    rows = np.asarray(rows)
+    pay = np.asarray(pay)
+    assert list(rows[:2]) == [3, 9]
+    assert (rows[2:] == vocab).all()
+    assert (pay[2:] == 0).all()
+    np.testing.assert_allclose(pay[0, :D], 3.0)   # sum g over 3 dups
+    np.testing.assert_allclose(pay[0, D:], 3.0)   # sum g² over 3 dups
+    np.testing.assert_allclose(pay[1, :D], 1.0)

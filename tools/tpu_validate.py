@@ -306,6 +306,62 @@ def main() -> int:
             "examples_per_sec": round(B * steps / dt, 1),
         }))
 
+    # ---- 3b. north-star vocab single chip (fail-soft) ------------------
+    # The flagship config (examples/criteo_1tb_dist.cfg) is V=2^26; the
+    # standard combos run V=2^22, where the O(V) terms of the tile apply
+    # are 16x cheaper.  One V=2^26 step answers (a) whether the [V, 9]
+    # table's PHYSICAL footprint allows it at all (HBM tiling may pad the
+    # minor dim to 128 lanes — the memory_stats question in
+    # TPU_STATUS.md's decision tree) and (b) what the tile path costs at
+    # the vocab the project is judged on.  Fail-soft: an OOM here is
+    # itself the measurement.
+    if on_tpu and not args.quick and not args.smoke:
+        v_ns = 1 << 26
+        cfg = FmConfig(
+            vocabulary_size=v_ns, factor_num=K, max_features=F,
+            batch_size=B, learning_rate=0.05, log_steps=0,
+            sparse_apply="tile", use_pallas=True,
+            model_file="/tmp/tpuval_northstar",
+        )
+        shutil.rmtree(cfg.model_file, ignore_errors=True)
+        try:
+            trainer = Trainer(cfg)
+            b_ns = trainer._put(Batch(
+                labels=rng.integers(0, 2, (B,)).astype(np.float32),
+                ids=rng.integers(0, v_ns, (B, F)).astype(np.int32),
+                vals=rng.uniform(0.1, 1.0, (B, F)).astype(np.float32),
+                fields=np.zeros((B, F), np.int32),
+                weights=np.ones((B,), np.float32),
+            ))
+            for _ in range(3):
+                trainer.state = trainer._train_step(trainer.state, b_ns)
+            drain(trainer.state)
+            steps = 10
+            t0 = time.perf_counter()
+            for i in range(steps):
+                trainer.state = trainer._train_step(trainer.state, b_ns)
+            drain((trainer.state.metrics.loss_sum,
+                   trainer.state.params.table[0, 0], trainer.state.step))
+            dt = time.perf_counter() - t0
+            stats = {}
+            try:
+                stats = jax.devices()[0].memory_stats() or {}
+            except Exception:  # noqa: BLE001 - optional on some backends
+                pass
+            emit(json.dumps({
+                "step": f"NORTH-STAR vocab=2^26 sparse_apply=tile B={B}",
+                "ms_per_step": round(dt * 1e3 / steps, 2),
+                "examples_per_sec": round(B * steps / dt, 1),
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            }))
+            del trainer
+        except Exception as e:  # noqa: BLE001 - OOM IS the data point
+            emit(json.dumps({
+                "step": "NORTH-STAR vocab=2^26 sparse_apply=tile",
+                "error": f"{type(e).__name__}: {e}"[:400],
+            }))
+
     if args.out:
         flags = "".join(
             f" --{name.replace('_', '-')}" for name in
