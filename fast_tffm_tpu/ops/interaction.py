@@ -166,3 +166,110 @@ def _bwd(impl, res, g):
 
 
 fm_interaction.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------- field-aware FM (FFM)
+#
+# Closed-form forward + backward for the field-grouped FFM interaction —
+# the single-device analogue of train.shardmap_step's inversion algebra
+# (reference FmScorer/FmGrad roles for BASELINE config 5).  Autodiff
+# through the einsum chain in models.fm.ffm_scores_from_rows re-derives
+# cotangents for every intermediate (oh*vals, S, v_own, ...); the closed
+# form reuses the saved field-grouped sums S and computes
+#
+#     dv_i^q = g x_i (S[q, f_i] - [q = f_i] v_i^{f_i} x_i),  dw_i = g x_i
+#
+# with one gather-by-field einsum.  Parity with the autodiff oracle is
+# test-enforced (tests/test_ffm_op.py).
+
+
+def _ffm_parts(rows, vals, fields, factor_num, field_num, compute_dtype):
+    """Shared forward math: (linear, s, self_term).
+
+    Mirrors models.fm.ffm_scores_from_rows operand-for-operand —
+    including which products see the bf16-ROUNDED operands — so the two
+    forwards agree to accumulation order in every compute_dtype.
+    """
+    from fast_tffm_tpu.platform import ffm_compute_dtype
+
+    cd = ffm_compute_dtype(compute_dtype)  # f32 off-TPU: CPU can't bf16-dot
+    rows = rows.astype(cd)
+    vals_c = vals.astype(cd)
+    b, f = vals.shape
+    w = rows[..., 0]
+    v = rows[..., 1:].reshape(b, f, field_num, factor_num)
+    linear = jnp.sum(w * vals_c, axis=-1, dtype=jnp.float32)
+    oh = (
+        fields[..., None] == jnp.arange(field_num, dtype=fields.dtype)
+    ).astype(cd)  # [B, F, P]
+    s = jnp.einsum(
+        "bfp,bfqk->bpqk", oh * vals_c[..., None], v,
+        preferred_element_type=jnp.float32,
+    )  # [B, P, P, k] field-grouped sums, f32
+    v_own = jnp.einsum(
+        "bfq,bfqk->bfk", oh, v, preferred_element_type=jnp.float32
+    )  # v_i^{f_i}
+    # The rounded vals square here must match the rounded diagonal of
+    # `cross` or the i = j cancellation leaves a bf16-eps residual.
+    self_term = jnp.sum(
+        jnp.sum(v_own * v_own, axis=-1) * (vals_c * vals_c),
+        axis=-1, dtype=jnp.float32,
+    )
+    return linear, s, self_term
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ffm_interaction(rows, vals, fields, factor_num, field_num,
+                    compute_dtype=jnp.float32):
+    """Per-example FFM interaction scores (without w0), differentiable
+    w.r.t. ``rows`` only.  Same numeric contract as
+    models.fm.ffm_scores_from_rows minus the w0 term: bf16 mode rounds
+    the operands, accumulation and scores stay f32."""
+    linear, s, self_term = _ffm_parts(
+        rows, vals, fields, factor_num, field_num, compute_dtype
+    )
+    cross = jnp.einsum("bpqk,bqpk->b", s, s)
+    return linear + 0.5 * (cross - self_term)
+
+
+def _ffm_fwd(rows, vals, fields, factor_num, field_num, compute_dtype):
+    linear, s, self_term = _ffm_parts(
+        rows, vals, fields, factor_num, field_num, compute_dtype
+    )
+    cross = jnp.einsum("bpqk,bqpk->b", s, s)
+    # Residuals: save only the inputs + S (what autodiff would keep
+    # anyway); oh/v_own are cheap one-hot recomputes in the backward.
+    return linear + 0.5 * (cross - self_term), (rows, vals, fields, s)
+
+
+def _ffm_bwd(factor_num, field_num, compute_dtype, res, g):
+    from fast_tffm_tpu.platform import ffm_compute_dtype
+
+    rows, vals, fields, s = res
+    b, f = vals.shape
+    # Same operand rounding as the forward/autodiff: products see the
+    # cd-rounded rows/vals, accumulation stays f32.
+    cd = ffm_compute_dtype(compute_dtype)
+    v = rows[..., 1:].astype(cd).reshape(b, f, field_num, factor_num)
+    vals32 = vals.astype(cd).astype(jnp.float32)
+    oh = (
+        fields[..., None] == jnp.arange(field_num, dtype=fields.dtype)
+    ).astype(cd)
+    v_own = jnp.einsum(
+        "bfq,bfqk->bfk", oh, v, preferred_element_type=jnp.float32
+    )
+    oh32 = oh.astype(jnp.float32)
+    gx = g[:, None] * vals32  # [B, F]
+    # T[b,f,q,:] = S[b, q, f_i, :]: gather S's second field axis by each
+    # occurrence's own field, as a one-hot matmul.
+    t = jnp.einsum("bqpk,bfp->bfqk", s, oh32)
+    dv = gx[..., None, None] * (
+        t - oh32[..., None] * v_own[:, :, None, :] * vals32[..., None, None]
+    )  # [B, F, P, k]
+    drows = jnp.concatenate(
+        [gx[..., None], dv.reshape(b, f, field_num * factor_num)], axis=-1
+    ).astype(rows.dtype)
+    return drows, None, None  # no gradients w.r.t. vals/fields
+
+
+ffm_interaction.defvjp(_ffm_fwd, _ffm_bwd)

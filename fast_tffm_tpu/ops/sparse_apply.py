@@ -348,7 +348,11 @@ def _compact_auto(n_entries: int, n_groups: int) -> bool:
     """Auto-engage compact K2 only when the entry count bounds touched
     groups to <= half the table's groups — streaming the whole table is
     faster when most blocks are touched anyway (no remap indirection,
-    denser pipelining)."""
+    denser pipelining).  FAST_TFFM_K2_COMPACT=0/1 overrides the
+    heuristic (hardware sweeps A/B it on chip)."""
+    override = os.environ.get("FAST_TFFM_K2_COMPACT")
+    if override in ("0", "1"):
+        return override == "1"
     return 2 * min(n_entries, n_groups) <= n_groups
 
 

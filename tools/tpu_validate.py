@@ -237,22 +237,26 @@ def main() -> int:
     from fast_tffm_tpu.train.loop import Trainer
 
     combos = [
-        # (sparse_apply, use_pallas, dtype, field_num, host_sort)
-        ("scatter", False, "float32", 0, True),
-        ("scatter", True, "float32", 0, True),
-        ("tile", False, "float32", 0, True),
+        # (sparse_apply, use_pallas, dtype, field_num, host_sort, env)
+        ("scatter", False, "float32", 0, True, {}),
+        ("scatter", True, "float32", 0, True, {}),
+        ("tile", False, "float32", 0, True, {}),
         # host_sort on/off at the default config: isolates the win from
         # moving the id sort + prep metadata onto pipeline threads.
-        ("tile", True, "float32", 0, False),
-        ("tile", True, "float32", 0, True),
-        ("tile", True, "bfloat16", 0, True),  # the fast path's bf16 variant
-        ("tile", "flat", "float32", 0, True),  # pure-XLA flat interaction
-        # Field-aware FM (BASELINE config 5): einsum interaction + the
-        # same sparse apply machinery; a hardware window must prove this
-        # path compiles and runs too, not just plain FM.
-        ("tile", True, "float32", 4, True),
+        ("tile", True, "float32", 0, False, {}),
+        ("tile", True, "float32", 0, True, {}),
+        ("tile", True, "bfloat16", 0, True, {}),  # the fast path's bf16
+        ("tile", "flat", "float32", 0, True, {}),  # pure-XLA flat
+        # Field-aware FM (BASELINE config 5): closed-form ffm_interaction
+        # (pinned "0" so an externally exported variable can't silently
+        # turn this into a second autodiff run) vs the autodiff einsum
+        # oracle — one window settles which backward wins on chip.
+        ("tile", True, "float32", 4, True, {"FAST_TFFM_FFM_AUTODIFF": "0"}),
+        ("tile", True, "float32", 4, True, {"FAST_TFFM_FFM_AUTODIFF": "1"}),
     ]
-    for mode, use_pallas, dtype, field_num, host_sort in combos:
+    for mode, use_pallas, dtype, field_num, host_sort, env in combos:
+        env_saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
         cfg = FmConfig(
             vocabulary_size=V, factor_num=K, max_features=F,
             batch_size=B, learning_rate=0.05, log_steps=0,
@@ -301,10 +305,16 @@ def main() -> int:
                 f"compute_dtype={dtype}"
                 + (f" field_num={field_num}" if field_num else "")
                 + ("" if host_sort else " host_sort=off")
+                + ("".join(f" {k}={v}" for k, v in env.items()))
             ),
             "ms_per_step": round(ms, 2),
             "examples_per_sec": round(B * steps / dt, 1),
         }))
+        for k, old in env_saved.items():  # restore, don't just delete
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
 
     # ---- 3b. north-star vocab single chip (fail-soft) ------------------
     # The flagship config (examples/criteo_1tb_dist.cfg) is V=2^26; the
