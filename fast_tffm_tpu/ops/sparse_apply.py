@@ -273,16 +273,15 @@ def _group_for(n_tiles: int, want: int | None = None) -> int:
     return group
 
 
-def _window_loop(ts_ref, u_hbm_ref, u_vmem, sem, *, tile, group, d, body,
-                 base=None):
-    """Double-buffered subtile loop shared by K2 and K-place.
+def _window_loop_raw(ts_ref, u_hbm_ref, u_vmem, sem, *, tile, group, body,
+                     base=None):
+    """Double-buffered entry-window loop — the ONE copy of the
+    slot/semaphore rotation protocol (layout-prototype kernels in
+    tools/micro_probe.py reuse it too; keep it that way).
 
     Walks ``group`` subtiles, DMA-ing each one's entry window while the
-    previous subtile's placement matmul runs (subtile j+1's copy is in
-    flight during subtile j's compute), and calls ``body(j, g1, g2)``
-    with the placed per-row sums.  This is the one copy of the
-    slot/semaphore rotation protocol — keep it that way.
-
+    previous subtile's compute runs (subtile j+1's copy is in flight
+    during subtile j's compute), and calls ``body(j, u_window, cnt)``.
     ``base`` is the first subtile's global index (defaults to the grid
     position; the compact K2 variant passes the remapped group index).
     """
@@ -302,8 +301,22 @@ def _window_loop(ts_ref, u_hbm_ref, u_vmem, sem, *, tile, group, d, body,
             window(j + 1, (j + 1) % 2).start()
         window(j, slot).wait()
         cnt = ts_ref[base + j + 1] - ts_ref[base + j]
-        g1, g2 = _placed_sums(u_vmem[slot], cnt, d, tile)
+        body(j, u_vmem[slot], cnt)
+
+
+def _window_loop(ts_ref, u_hbm_ref, u_vmem, sem, *, tile, group, d, body,
+                 base=None):
+    """_window_loop_raw + the standard [R, R] one-hot placement:
+    ``body(j, g1, g2)`` receives the placed per-row sums."""
+
+    def raw_body(j, u, cnt):
+        g1, g2 = _placed_sums(u, cnt, d, tile)
         body(j, g1, g2)
+
+    _window_loop_raw(
+        ts_ref, u_hbm_ref, u_vmem, sem, tile=tile, group=group,
+        body=raw_body, base=base,
+    )
 
 
 def _k2_group_kernel(ts_ref, *args, n_tables, tile, group, d, update):

@@ -311,3 +311,21 @@ def test_transposed_k2_probe_lowers():
         functools.partial(micro_probe.k2t_apply, lr=0.05, eps=1e-7),
         _s((D, V)), _s((D, V)), _s((N,), jnp.int32), _s((N, D)),
     )
+
+
+def test_packed_k2_probe_lowers():
+    """The packed [V/8, 128] super-row K2 prototype must pass Mosaic
+    lowering (its lane-spread one-hot matmuls and packed block specs
+    are structurally new)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import micro_probe
+
+    lower_tpu(
+        functools.partial(micro_probe.k2p_apply, lr=0.05, eps=1e-7),
+        _s((V // 8, 128)), _s((V // 8, 128)), _s((N,), jnp.int32),
+        _s((N, D)),
+    )

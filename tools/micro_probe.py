@@ -45,24 +45,7 @@ from fast_tffm_tpu.ops import sparse_apply as sa
 
 def _k2t_kernel(ts_ref, table_ref, acc_ref, u_hbm_ref, table_out_ref,
                 acc_out_ref, u_vmem, sem, *, tile, group, d, lr, eps):
-    base = pl.program_id(0) * group
-
-    def window(j, slot):
-        start = ts_ref[base + j]
-        return pltpu.make_async_copy(
-            u_hbm_ref.at[pl.ds(start, tile)], u_vmem.at[slot],
-            sem.at[slot],
-        )
-
-    window(0, 0).start()
-    for j in range(group):
-        slot = j % 2
-        if j + 1 < group:
-            window(j + 1, (j + 1) % 2).start()
-        window(j, slot).wait()
-        start = ts_ref[base + j]
-        cnt = ts_ref[base + j + 1] - start
-        u = u_vmem[slot]  # [R, L]
+    def body(j, u, cnt):
         e_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
         u = jnp.where(e_iota < cnt, u, 0.0)
         lrow = u[:, 2 * d:2 * d + 1].astype(jnp.int32)
@@ -84,6 +67,10 @@ def _k2t_kernel(ts_ref, table_ref, acc_ref, u_hbm_ref, table_out_ref,
         table_out_ref[:, cols] = table_ref[:, cols] - lr * g1t * (
             jax.lax.rsqrt(acc_new + eps))
         acc_out_ref[:, cols] = acc_new
+
+    sa._window_loop_raw(
+        ts_ref, u_hbm_ref, u_vmem, sem, tile=tile, group=group, body=body
+    )
 
 def k2t_apply(table_t, acc_t, ids_, g_rows, *, lr, eps):
     vocab = table_t.shape[1]
@@ -110,6 +97,120 @@ def k2t_apply(table_t, acc_t, ids_, g_rows, *, lr, eps):
         input_output_aliases={1: 0, 2: 1},
         interpret=jax.default_backend() == "cpu",
     )(tile_start, table_t, acc_t, u)
+
+
+def _k2p_kernel(ts_ref, table_ref, acc_ref, u_hbm_ref, table_out_ref,
+                acc_out_ref, u_vmem, sem, *, tile, group, d, lr, eps):
+    """Packed-layout K2: tables stored [V/8, 128] — 8 consecutive rows
+    of 16 lanes (d values + pad) per 128-lane line, so the physical HBM
+    stream is ~1.8x logical instead of the ~14x a lane-padded [V, 9]
+    layout costs (decision tree in TPU_STATUS.md).  Placement: entry
+    payloads are lane-shifted into their slot with pure VPU iota math
+    (no relayout reshapes), then one [R, lines] one-hot matmul sums
+    them per packed line."""
+    lines = tile // 8
+    # Loop-invariant one-hot constants, hoisted out of the unrolled
+    # subtile loop (this kernel is timed against production — redundant
+    # per-iteration VPU constant builds would bias the comparison).
+    # Lane-slot packing is done with one-hot matmuls: lane gathers/
+    # shuffles have no reliable Mosaic lowering, and 0/1 matrices are
+    # bf16-exact so only u needs the hi/lo split.  G_g1[a, c] =
+    # (a == c%16 < d) spreads the g1 lanes into every 16-lane slot.
+    e_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, 128), 1)
+    a_iota = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+    cmod = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1) % 16
+    keep = cmod < d
+    g_g1 = ((a_iota == cmod) & keep).astype(jnp.bfloat16)
+    g_g2 = ((a_iota == cmod + d) & keep).astype(jnp.bfloat16)
+    l_iota = jax.lax.broadcasted_iota(jnp.int32, (tile, lines), 1)
+    dn = (((0,), (0,)), ((), ()))  # contract entries
+
+    def body(j, u, cnt):
+        valid = e_iota < cnt
+        u = jnp.where(valid, u, 0.0)
+        lrow = u[:, 2 * d:2 * d + 1].astype(jnp.int32)  # [R, 1]
+        # slotmask keeps only the entry's own 16-lane slot.
+        slotmask = ((c_iota // 16) == (lrow % 8)).astype(jnp.float32)
+        u_hi = u.astype(jnp.bfloat16)
+        u_lo = (u - u_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+        def spread(gmat):  # [R, 128] with pay lanes in every slot
+            return (
+                jax.lax.dot(u_hi, gmat,
+                            preferred_element_type=jnp.float32)
+                + jax.lax.dot(u_lo, gmat,
+                              preferred_element_type=jnp.float32)
+            )
+
+        g1_sl = spread(g_g1) * slotmask  # [R, 128] slotted
+        g2_sl = spread(g_g2) * slotmask
+        # Line one-hot [R, lines] and the two placement matmuls.
+        p = (((lrow // 8) == l_iota) & valid).astype(jnp.bfloat16)
+
+        def place(x):
+            x_hi = x.astype(jnp.bfloat16)
+            x_lo = (x - x_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+            return (
+                jax.lax.dot_general(p, x_hi, dn,
+                                    preferred_element_type=jnp.float32)
+                + jax.lax.dot_general(p, x_lo, dn,
+                                      preferred_element_type=jnp.float32)
+            )  # [lines, 128]
+
+        g1p = place(g1_sl)
+        g2p = place(g2_sl)
+        rows = pl.ds(j * lines, lines)
+        acc_new = acc_ref[rows, :] + g2p
+        table_out_ref[rows, :] = table_ref[rows, :] - lr * g1p * (
+            jax.lax.rsqrt(acc_new + eps))
+        acc_out_ref[rows, :] = acc_new
+
+    sa._window_loop_raw(
+        ts_ref, u_hbm_ref, u_vmem, sem, tile=tile, group=group, body=body
+    )
+
+
+def k2p_apply(table_p, acc_p, ids_, g_rows, *, lr, eps):
+    """table_p/acc_p are packed [vocab/8, 128] (8 rows x 16 lanes)."""
+    vocab = table_p.shape[0] * 8
+    d = g_rows.shape[1]
+    u, tile_start = sa._dedup_and_starts(ids_, g_rows, vocab)
+    tile, group = sa.TILE, sa._group_for(vocab // sa.TILE)
+    block_lines = (tile * group) // 8
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(vocab // (tile * group),),
+        in_specs=[pl.BlockSpec((block_lines, 128), lambda t, *_: (t, 0))] * 2
+        + [pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec((block_lines, 128),
+                                lambda t, *_: (t, 0))] * 2,
+        scratch_shapes=[
+            pltpu.VMEM((2, tile, u.shape[1]), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _partial(_k2p_kernel, tile=tile, group=group, d=d, lr=lr, eps=eps),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((vocab // 8, 128), jnp.float32)] * 2,
+        input_output_aliases={1: 0, 2: 1},
+        interpret=jax.default_backend() == "cpu",
+    )(tile_start, table_p, acc_p, u)
+
+
+def pack_table(t, d):
+    """[V, d] -> packed [V/8, 128] (8 rows x 16 lanes, zero pad)."""
+    v = t.shape[0]
+    padded = jnp.concatenate(
+        [t, jnp.zeros((v, 16 - d), t.dtype)], axis=1
+    )
+    return padded.reshape(v // 8, 128)
+
+
+def unpack_table(tp, d):
+    v8 = tp.shape[0]
+    return tp.reshape(v8 * 8, 16)[:, :d]
 
 
 def main() -> int:
@@ -334,6 +435,46 @@ def main() -> int:
         del t_t, a_t
     except Exception as exc:  # noqa: BLE001 — a probe must not die here
         print(f"  K2-transposed probe FAILED: {type(exc).__name__}: "
+              f"{str(exc).splitlines()[0][:140]}", flush=True)
+
+    # ---- packed-K2 prototype ------------------------------------------
+    # Third layout option: [V/8, 128] super-rows (8 rows x 16 lanes).
+    # Physical stream ~1.8x logical (16/9) with a dense 128-lane minor
+    # dim — vs ~14x for lane-padded [V, 9].  Costs two extra lane-spread
+    # matmuls per subtile; whether that trade wins is exactly what this
+    # times against production and the transposed prototype.
+    k2p = jax.jit(_partial(k2p_apply, lr=0.05, eps=1e-7))
+    try:
+        if jax.default_backend() == "cpu":
+            vs, ns = 4096, 2048
+            tbs = jnp.asarray(rng.uniform(-0.1, 0.1, (vs, d9)), jnp.float32)
+            acs = jnp.full((vs, d9), 0.1, jnp.float32)
+            idss = jnp.asarray(rng.integers(0, vs, (ns,)), jnp.int32)
+            gs = jnp.asarray(
+                rng.uniform(-1e-2, 1e-2, (ns, d9)), jnp.float32)
+            t_p, a_p = k2p(
+                pack_table(tbs, d9), pack_table(acs, d9), idss, gs)
+            a_ref3 = acs.at[idss].add(gs * gs)
+            t_ref3 = tbs.at[idss].add(
+                -0.05 * gs * jax.lax.rsqrt(a_ref3[idss] + 1e-7))
+            errp = float(jnp.max(jnp.abs(unpack_table(t_p, d9) - t_ref3)))
+            print(f"  K2-packed parity err {errp:.2e} (interpret, "
+                  f"V={vs} n={ns})", flush=True)
+        else:
+            tp, ap = pack_table(tbl, d9), pack_table(accv, d9)
+            t_p, a_p = k2p(tp, ap, ids, gk)
+            a_ref3 = accv.at[ids].add(gk * gk)
+            t_ref3 = tbl.at[ids].add(
+                -0.05 * gk * jax.lax.rsqrt(a_ref3[ids] + 1e-7))
+            errp = float(jnp.max(jnp.abs(unpack_table(t_p, d9) - t_ref3)))
+            ms_pk = bench(k2p, tp, ap, ids, gk)
+            print(
+                f"  K2 packed [V/8,128]: {ms_pk:7.3f} ms (parity err "
+                f"{errp:.2e}); compare transposed/production above",
+                flush=True)
+        del t_p, a_p
+    except Exception as exc:  # noqa: BLE001 — a probe must not die here
+        print(f"  K2-packed probe FAILED: {type(exc).__name__}: "
               f"{str(exc).splitlines()[0][:140]}", flush=True)
     del gk, tbl, accv
 
