@@ -148,8 +148,9 @@ class FmConfig:
     # (sparse-friendly); "full" regularizes the whole table (dense grads,
     # only sane for small vocabularies).
     l2_mode: str = "batch"
-    # How the shardmap step exchanges sparse updates over the data axis
-    # (the reference's IndexedSlices push, SURVEY.md §3.2): "dense" psums
+    # How multi-device sparse updates are exchanged over the data axis
+    # (both the shardmap step and the GSPMD sharded tile apply; the
+    # reference's IndexedSlices push, SURVEY.md §3.2): "dense" psums
     # a [vocab_local, 2D] delta (O(vocab), simple, best at small vocab /
     # large batch); "entries" all-gathers only the deduped touched-row
     # entry streams (batch-proportional, vocab-independent — the scaling

@@ -527,6 +527,26 @@ def dense_delta(ids, g_rows, *, vocab, vocab_local, row_lo):
 # ------------------------------------------- entries exchange (sharded path)
 
 
+def resolve_exchange(mode: str, *, n_local_occ: int, vocab_local: int,
+                     d: int, data_shards: int) -> str:
+    """Resolve a sparse_exchange config value for static shapes.
+
+    "dense" psums a [vocab_local, 2D] delta over the data axis — bytes
+    grow with vocab, independent of the batch.  "entries" all-gathers
+    the deduped touched-row streams — bytes grow with the batch,
+    independent of vocab (the reference PS design's IndexedSlices
+    scaling, SURVEY.md §3.2).  "auto" picks whichever moves fewer
+    words per device (psum and all-gather have comparable per-word
+    ring cost on ICI).
+    """
+    if mode != "auto":
+        return mode
+    cap = entries_cap(n_local_occ, vocab_local)
+    entries_words = data_shards * cap * (2 * d + 1)
+    dense_words = vocab_local * 2 * d
+    return "entries" if entries_words < dense_words else "dense"
+
+
 def entries_cap(n_occurrences: int, vocab: int) -> int:
     """Static per-shard entry-stream capacity for the entries exchange.
 
@@ -604,6 +624,22 @@ def k2_apply(update, tile_start, u, tables, compact=None):
     stream (as produced by merge_entries) to ``tables``."""
     return _k2_call(update, tile_start, u, tables, u.shape[1],
                     compact=compact)
+
+
+def entries_exchange(lids, g_rows, *, vocab_local, data_axis):
+    """The ONE copy of the entries-exchange protocol (shard_map body):
+    dedupe LOCAL-coordinate occurrences (off-shard ids pre-mapped to the
+    sentinel ``vocab_local``, their payloads zeroed), all-gather the
+    touched-entry streams over ``data_axis``, merge.  Returns the
+    K2-ready ``(u, tile_start)``.  Both the shardmap step and the GSPMD
+    sharded apply call this — keep it the only copy."""
+    cap = entries_cap(lids.shape[0], vocab_local)
+    rows_e, pay_e, _ = unique_entries(
+        lids, g_rows, vocab=vocab_local, cap=cap
+    )
+    rows_all = jax.lax.all_gather(rows_e, data_axis, axis=0, tiled=True)
+    pay_all = jax.lax.all_gather(pay_e, data_axis, axis=0, tiled=True)
+    return merge_entries(rows_all, pay_all, vocab=vocab_local)
 
 
 # ------------------------------------------------------------ orchestration
@@ -807,32 +843,53 @@ def supports_tile_sharded(vocab: int, optimizer: str, model_shards: int) -> bool
 
 
 def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
-                  g_rows, vocab):
-    """shard_map wrapper: per-device K1 + dense placement, psum over data,
-    elementwise optimizer update on the local table shard.
+                  g_rows, vocab, exchange="dense"):
+    """shard_map wrapper: per-device K1 dedup, then either a dense
+    per-shard delta psum over the data axis (``exchange="dense"``) or a
+    batch-proportional all-gather of the touched-entry streams
+    (``"entries"``), then the optimizer update on the local table shard.
 
     This is the GSPMD-era replacement for the reference's PS scatter push
-    (SURVEY.md §3.2): the routing of sparse updates to owning shards
-    becomes a dense per-shard delta allreduced over the data axis — the
-    same collective pattern as sync data-parallel gradient exchange, so it
-    rides ICI.
+    (SURVEY.md §3.2): dense mode uses the sync-DP gradient-allreduce
+    collective pattern (O(vocab) bytes); entries mode keeps the PS
+    design's IndexedSlices property — bytes scale with the batch,
+    independent of vocab.
     """
     from jax.sharding import PartitionSpec as P
 
     model_shards = mesh.shape[model_axis]
     vocab_local = vocab // model_shards
+    n_tables = len(tables)
 
     def local(ids_l, g_l, *tables_l):
         m = jax.lax.axis_index(model_axis)
+        row_lo = m * vocab_local
+        d = g_l.shape[1]
+        if exchange == "entries":
+            in_range = (ids_l >= row_lo) & (ids_l < row_lo + vocab_local)
+            lids = jnp.where(
+                in_range, ids_l - row_lo, vocab_local
+            ).astype(jnp.int32)
+            g_masked = jnp.where(in_range[:, None], g_l, 0.0)
+            u2, ts2 = entries_exchange(
+                lids, g_masked, vocab_local=vocab_local,
+                data_axis=data_axis,
+            )
+            # k2_apply expects update -> tuple; the single-table (sgd)
+            # wrapper returns a bare array.
+            upd = (
+                update_fn if n_tables > 1
+                else (lambda g1, g2, *t: (update_fn(g1, g2, *t),))
+            )
+            out = k2_apply(upd, ts2, u2, tuple(tables_l))
+            return tuple(out) if n_tables > 1 else out[0]
         dense = dense_delta(
             ids_l, g_l, vocab=vocab,
-            vocab_local=vocab_local, row_lo=m * vocab_local,
+            vocab_local=vocab_local, row_lo=row_lo,
         )
         dense = jax.lax.psum(dense, data_axis)
-        d = g_l.shape[1]
         return update_fn(dense[:, :d], dense[:, d:], *tables_l)
 
-    n_tables = len(tables)
     return jax.shard_map(
         local,
         mesh=mesh,
@@ -845,29 +902,29 @@ def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
 
 
 def adagrad_apply_sharded(table, acc, ids, g_rows, *, lr, eps, mesh,
-                          data_axis, model_axis):
+                          data_axis, model_axis, exchange="dense"):
     def update(g1, g2, table_l, acc_l):
         return adagrad_update(g1, g2, table_l, acc_l, lr=lr, eps=eps)
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table, acc), ids, g_rows,
-        table.shape[0],
+        table.shape[0], exchange=exchange,
     )
 
 
 def sgd_apply_sharded(table, ids, g_rows, *, lr, mesh, data_axis,
-                      model_axis):
+                      model_axis, exchange="dense"):
     def update(g1, g2, table_l):
         return sgd_update(g1, g2, table_l, lr=lr)[0]
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table,), ids, g_rows,
-        table.shape[0],
+        table.shape[0], exchange=exchange,
     )
 
 
 def ftrl_apply_sharded(table, z, n, ids, g_rows, *, lr, l1, l2, beta, mesh,
-                       data_axis, model_axis):
+                       data_axis, model_axis, exchange="dense"):
     def update(g1, g2, table_l, z_l, n_l):
         return ftrl_update(
             g1, g2, table_l, z_l, n_l, lr=lr, l1=l1, l2=l2, beta=beta
@@ -875,5 +932,5 @@ def ftrl_apply_sharded(table, z, n, ids, g_rows, *, lr, l1, l2, beta, mesh,
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table, z, n), ids, g_rows,
-        table.shape[0],
+        table.shape[0], exchange=exchange,
     )

@@ -73,18 +73,13 @@ def exchange_mode(cfg: FmConfig, mesh, n_local_occ: int) -> str:
     independent of vocab (the reference PS design's IndexedSlices
     scaling, SURVEY.md §3.2).  "auto" picks whichever moves fewer bytes.
     """
-    if cfg.sparse_exchange != "auto":
-        return cfg.sparse_exchange
-    d = cfg.embedding_dim
-    vocab_local = cfg.vocabulary_size // mesh.shape[MODEL_AXIS]
-    data_shards = mesh.shape[DATA_AXIS]
-    cap = sparse_apply.entries_cap(n_local_occ, vocab_local)
-    # Per-device words received: all-gather of S streams of (row + 2D
-    # payload) vs a [vocab_local, 2D] psum (counted once — psum and
-    # all-gather have comparable per-word ring cost on ICI).
-    entries_words = data_shards * cap * (2 * d + 1)
-    dense_words = vocab_local * 2 * d
-    return "entries" if entries_words < dense_words else "dense"
+    return sparse_apply.resolve_exchange(
+        cfg.sparse_exchange,
+        n_local_occ=n_local_occ,
+        vocab_local=cfg.vocabulary_size // mesh.shape[MODEL_AXIS],
+        d=cfg.embedding_dim,
+        data_shards=mesh.shape[DATA_AXIS],
+    )
 
 
 def _dscore(scores, labels, loss_type):
@@ -258,19 +253,11 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
             # only the touched entries over the data axis, merge the S
             # sorted streams, apply via K2.  Comms are independent of
             # vocab — the reference's IndexedSlices scaling property.
-            cap = sparse_apply.entries_cap(b * f, vocab_local)
-            rows_e, pay_e, _ = sparse_apply.unique_entries(
+            # (ids_flat is already local-coordinate with off-shard ->
+            # sentinel, the helper's contract; drows already masked.)
+            u2, ts2 = sparse_apply.entries_exchange(
                 ids_flat.astype(jnp.int32), g_flat,
-                vocab=vocab_local, cap=cap,
-            )
-            rows_all = jax.lax.all_gather(
-                rows_e, DATA_AXIS, axis=0, tiled=True
-            )
-            pay_all = jax.lax.all_gather(
-                pay_e, DATA_AXIS, axis=0, tiled=True
-            )
-            u2, ts2 = sparse_apply.merge_entries(
-                rows_all, pay_all, vocab=vocab_local
+                vocab_local=vocab_local, data_axis=DATA_AXIS,
             )
             w_new, new_tables = _apply_stream(
                 cfg, ts2, u2, table_l, opt_tables_l

@@ -173,6 +173,17 @@ def _rows_loss_fn(
     return loss_fn
 
 
+def _sharded_exchange(cfg, mesh, ids, g_rows) -> str:
+    """Resolve cfg.sparse_exchange for the GSPMD 'sharded' apply mode."""
+    return sparse_apply.resolve_exchange(
+        cfg.sparse_exchange,
+        n_local_occ=ids.shape[0] // mesh.shape[mesh_lib.DATA_AXIS],
+        vocab_local=cfg.vocabulary_size // mesh.shape[mesh_lib.MODEL_AXIS],
+        d=g_rows.shape[1],
+        data_shards=mesh.shape[mesh_lib.DATA_AXIS],
+    )
+
+
 def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows,
                    mode="scatter", mesh=None, meta=None):
     del w_rows  # adagrad needs no pre-update weights
@@ -184,6 +195,7 @@ def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows,
             params.table, opt.acc.table, ids, g_rows,
             lr=lr, eps=ADAGRAD_EPS, mesh=mesh,
             data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
+            exchange=_sharded_exchange(cfg, mesh, ids, g_rows),
         )
     elif mode == "tile":
         table, acc_table = sparse_apply.adagrad_apply(
@@ -218,6 +230,7 @@ def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows,
             params.table, opt.z.table, opt.n.table, ids, g_rows,
             lr=lr, l1=l1, l2=l2, beta=beta, mesh=mesh,
             data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
+            exchange=_sharded_exchange(cfg, mesh, ids, g_rows),
         )
     elif mode == "tile":
         table, z_table, n_table = sparse_apply.ftrl_apply(
@@ -268,6 +281,7 @@ def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows,
         table = sparse_apply.sgd_apply_sharded(
             params.table, ids, g_rows, lr=lr, mesh=mesh,
             data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
+            exchange=_sharded_exchange(cfg, mesh, ids, g_rows),
         )
     elif mode == "tile":
         table = sparse_apply.sgd_apply(

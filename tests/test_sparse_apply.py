@@ -224,9 +224,12 @@ def test_supports_tile_gating():
     assert not sparse_apply.supports_tile_sharded(2048, "ftrl", 16)
 
 
+@pytest.mark.parametrize("exchange", ["dense", "entries"])
 @pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4)])
-def test_adagrad_sharded_matches_scatter(shape):
-    """Sharded tile apply on a (data, model) virtual mesh == scatter."""
+def test_adagrad_sharded_matches_scatter(shape, exchange):
+    """Sharded tile apply on a (data, model) virtual mesh == scatter,
+    for both the dense-delta psum and the batch-proportional entries
+    exchange."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     V_s = 4096  # divisible by model_shards * TILE for model <= 16
@@ -247,7 +250,7 @@ def test_adagrad_sharded_matches_scatter(shape):
     t_tile, a_tile = jax.jit(
         lambda t, a, i, g: sparse_apply.adagrad_apply_sharded(
             t, a, i, g, lr=lr, eps=eps, mesh=mesh,
-            data_axis="data", model_axis="model",
+            data_axis="data", model_axis="model", exchange=exchange,
         )
     )(table_sh, acc_sh, ids_sh, g_sh)
 
@@ -474,3 +477,60 @@ def test_unique_entries_sentinel_padding():
     np.testing.assert_allclose(pay[0, :D], 3.0)   # sum g over 3 dups
     np.testing.assert_allclose(pay[0, D:], 3.0)   # sum g² over 3 dups
     np.testing.assert_allclose(pay[1, :D], 1.0)
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "ftrl"])
+def test_sgd_ftrl_sharded_entries_match_single_device(optimizer):
+    """sgd (the n_tables==1 tuple-wrapping path) and ftrl (3 tables)
+    through the GSPMD sharded apply with exchange=entries must match the
+    single-device tile apply (itself scatter-parity-tested above).
+    FTRL's table honors the w == ftrl_solve(z, n) invariant contract."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    V_s = 4096
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    rng = np.random.default_rng(12)
+    ids = jnp.asarray(rng.integers(0, V_s, (2048,)), jnp.int32)
+    g = jnp.asarray(rng.uniform(-1, 1, (2048, D)), jnp.float32)
+    lr, l1, l2, beta = 0.05, 0.01, 0.1, 1.0
+    sh_m = NamedSharding(mesh, P("model", None))
+    sh_d = NamedSharding(mesh, P("data"))
+    sh_dn = NamedSharding(mesh, P("data", None))
+
+    if optimizer == "sgd":
+        table = jnp.asarray(rng.uniform(-0.1, 0.1, (V_s, D)), jnp.float32)
+        t_ref = sparse_apply.sgd_apply(table, ids, g, lr=lr)
+        t_sh = jax.jit(
+            lambda t, i, gg: sparse_apply.sgd_apply_sharded(
+                t, i, gg, lr=lr, mesh=mesh, data_axis="data",
+                model_axis="model", exchange="entries",
+            )
+        )(jax.device_put(table, sh_m), jax.device_put(ids, sh_d),
+          jax.device_put(g, sh_dn))
+        # rtol 1e-4 like the other sharded parity tests: the merged
+        # streams sum cross-shard partials in a different order than the
+        # single-device K1.
+        np.testing.assert_allclose(
+            np.asarray(t_sh), np.asarray(t_ref), rtol=1e-4, atol=1e-5
+        )
+    else:
+        z = jnp.asarray(rng.uniform(-1, 1, (V_s, D)), jnp.float32)
+        n = jnp.full((V_s, D), 0.5, jnp.float32)
+        table = sparse_apply.ftrl_solve(z, n, lr, l1, l2, beta)
+        refs = sparse_apply.ftrl_apply(
+            table, z, n, ids, g, lr=lr, l1=l1, l2=l2, beta=beta
+        )
+        shs = jax.jit(
+            lambda t, zz, nn, i, gg: sparse_apply.ftrl_apply_sharded(
+                t, zz, nn, i, gg, lr=lr, l1=l1, l2=l2, beta=beta,
+                mesh=mesh, data_axis="data", model_axis="model",
+                exchange="entries",
+            )
+        )(jax.device_put(table, sh_m), jax.device_put(z, sh_m),
+          jax.device_put(n, sh_m), jax.device_put(ids, sh_d),
+          jax.device_put(g, sh_dn))
+        for a, b in zip(shs, refs):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
