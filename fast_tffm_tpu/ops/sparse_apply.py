@@ -653,20 +653,10 @@ def _tile_starts(sidx, upos, boundaries):
     return upos_ext[ss].astype(jnp.int32)
 
 
-def _cumsum_counts(flags):
-    """Prefix sum of 0/1 flags, MXU-shaped.
-
-    XLA lowers a length-640k 1-D cumsum to log-depth VPU passes in a
-    lane-hostile layout (~4.7 ms measured on v5e — comparable to the
-    whole K1 kernel).  Reshaping to [rows, 128] turns the within-row
-    prefix into one [rows,128]x[128,128] triangular matmul plus a
-    128x-shorter cumsum of row totals.  Exact: counts are integers
-    < 2^24, f32-representable; falls back to jnp.cumsum for shapes the
-    reshape or exactness argument does not cover.
-    """
+def _cumsum_mxu(flags):
+    """Prefix sum of 0/1 flags via one triangular matmul — exact only
+    while the total stays < 2^24 (f32 integers)."""
     n = flags.shape[0]
-    if n % 128 or n >= 1 << 24:
-        return jnp.cumsum(flags)
     m = flags.reshape(n // 128, 128).astype(jnp.float32)
     # within[r, c] = sum_{k<=c} m[r, k] needs tri[k, c] = (k <= c):
     # upper-triangular (tril would give suffix sums).
@@ -678,6 +668,37 @@ def _cumsum_counts(flags):
     row_tot = within[:, -1]
     offs = jnp.cumsum(row_tot) - row_tot
     return (within + offs[:, None]).reshape(n).astype(flags.dtype)
+
+
+def _cumsum_counts(flags):
+    """Prefix sum of 0/1 flags, MXU-shaped and exact at any length.
+
+    XLA lowers a length-640k 1-D cumsum to log-depth VPU passes in a
+    lane-hostile layout (~4.7 ms measured on v5e — comparable to the
+    whole K1 kernel).  Reshaping to [rows, 128] turns the within-row
+    prefix into one [rows,128]x[128,128] triangular matmul plus a
+    128x-shorter cumsum of row totals; f32 keeps that exact below 2^24
+    counts.  Above (the flagship B=262k step has 10.2M occurrences),
+    a two-level split stays exact: segments of < 2^24 get the MXU
+    prefix (segment-LOCAL counts < segment length, f32-exact), and the
+    tiny integer cumsum of segment totals supplies exact int32 offsets.
+    Falls back to jnp.cumsum only when no 128-multiple segment divides n.
+    """
+    n = flags.shape[0]
+    if n % 128:
+        return jnp.cumsum(flags)
+    if n < 1 << 24:
+        return _cumsum_mxu(flags)
+    seg = 1 << 23
+    while n % seg:
+        seg >>= 1
+    if seg < 128:  # n % 128 == 0 makes this unreachable; belt+braces
+        return jnp.cumsum(flags)
+    m = flags.reshape(n // seg, seg)
+    within = jax.vmap(_cumsum_mxu)(m)  # [S, seg], ints < 2^23 each
+    seg_tot = within[:, -1]
+    offs = jnp.cumsum(seg_tot) - seg_tot  # int32: exact at any total
+    return (within + offs[:, None]).reshape(n)
 
 
 def _pad_lanes(x):

@@ -39,22 +39,25 @@ needs_scale_env = pytest.mark.skipif(
 
 
 def test_cumsum_counts_2e24_cutoff_exact():
-    """The MXU prefix sum is f32-exact only below 2^24 counts; at and
-    above the cutoff _cumsum_counts must take the jnp.cumsum fallback
-    and stay integer-exact.  Checked at the boundary on both sides."""
-    n_over = 1 << 24  # >= cutoff -> fallback path
-    flags = jnp.ones((n_over,), jnp.int32)
-    out = sparse_apply._cumsum_counts(flags)
-    # all-ones cumsum == iota+1; the tail is where f32 would round.
-    np.testing.assert_array_equal(
-        np.asarray(out[-4:]), np.arange(n_over - 3, n_over + 1)
-    )
-    n_under = (1 << 24) - 128  # < cutoff, 128-divisible -> MXU path
-    flags = jnp.ones((n_under,), jnp.int32)
-    out = sparse_apply._cumsum_counts(flags)
-    np.testing.assert_array_equal(
-        np.asarray(out[-4:]), np.arange(n_under - 3, n_under + 1)
-    )
+    """The single-level MXU prefix sum is f32-exact only below 2^24
+    counts; at and above the cutoff _cumsum_counts must switch to the
+    two-level split (MXU within < 2^24 segments + exact int32 offsets)
+    and stay integer-exact.  All-ones flags maximize the total, so the
+    tail elements are exactly where f32 would round."""
+    for n in [
+        (1 << 24) - 128,   # single-level MXU path, just under cutoff
+        1 << 24,           # two-level path, seg = 2^23
+        512 * 32769,       # > 2^24 with odd segment count: seg shrinks
+                           # to 512 (deep halving) and 32769 segments
+    ]:
+        flags = jnp.ones((n,), jnp.int32)
+        out = sparse_apply._cumsum_counts(flags)
+        np.testing.assert_array_equal(
+            np.asarray(out[-4:]), np.arange(n - 3, n + 1), err_msg=str(n)
+        )
+        # A middle probe too (offsets wrong by a segment would show).
+        mid = n // 2 + 64
+        assert int(out[mid - 1]) == mid, n
 
 
 def test_tile_starts_int32_at_flagship_vocab():
