@@ -57,6 +57,33 @@ local = np.concatenate(
     [np.asarray(s.data).ravel() for s in table.addressable_shards]
 )
 print("FINGERPRINT", float(np.abs(local).sum()), float(trainer.state.metrics.loss_sum))
+
+# Second phase, same process pair (amortizes cluster startup): the
+# shardmap step with the batch-proportional entries exchange — its
+# all-gather of touched-entry streams crosses REAL process boundaries
+# here, not just a virtual mesh.
+cfg2 = FmConfig(
+    vocabulary_size=2048, factor_num=8, max_features=8, batch_size=32,
+    mesh_data=2, mesh_model=2, lookup="shardmap",
+    sparse_exchange="entries",
+    model_file="/tmp/fftpu_dist_e_" + sys.argv[2], log_steps=0,
+)
+trainer2 = Trainer(cfg2)
+for _ in range(2):
+    batch = Batch(
+        labels=rng.integers(0, 2, size=(32,)).astype(np.float32),
+        ids=rng.integers(0, 2048, size=(32, 8)).astype(np.int32),
+        vals=rng.uniform(0.1, 1.0, size=(32, 8)).astype(np.float32),
+        fields=np.zeros((32, 8), np.int32),
+        weights=np.ones((32,), np.float32),
+    )
+    trainer2.state = trainer2._train_step(trainer2.state, trainer2._put(batch))
+table2 = trainer2.state.params.table
+local2 = np.concatenate(
+    [np.asarray(s.data).ravel() for s in table2.addressable_shards]
+)
+print("FINGERPRINT2", float(np.abs(local2).sum()),
+      float(trainer2.state.metrics.loss_sum))
 """
 
 
@@ -94,7 +121,8 @@ def test_two_process_distributed_training(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.communicate()
-    fps = [l for o in outs for l in o.splitlines() if l.startswith("FINGERPRINT")]
+    fps = [l for o in outs for l in o.splitlines()
+           if l.startswith("FINGERPRINT ")]
     assert len(fps) == 2
     # Same global metrics on both processes (replicated state agrees).
     m0 = float(fps[0].split()[2])
@@ -102,6 +130,14 @@ def test_two_process_distributed_training(tmp_path):
     np.testing.assert_allclose(m0, m1, rtol=1e-6)
     # Loss is finite and training actually ran.
     assert m0 > 0 and np.isfinite(m0)
+    # Phase 2: shardmap + entries exchange across process boundaries.
+    fps2 = [l for o in outs for l in o.splitlines()
+            if l.startswith("FINGERPRINT2")]
+    assert len(fps2) == 2
+    e0 = float(fps2[0].split()[2])
+    e1 = float(fps2[1].split()[2])
+    np.testing.assert_allclose(e0, e1, rtol=1e-6)
+    assert e0 > 0 and np.isfinite(e0)
 
 
 _WORKER_FILES = r"""
