@@ -123,7 +123,9 @@ class FmConfig:
     # Interaction implementation: '' derives from use_pallas (True ->
     # 'pallas', False -> 'jnp'); 'flat' selects the pure-XLA flat-layout
     # one-hot-matmul variant (same math as the Pallas kernels, fused by
-    # XLA instead).
+    # XLA instead).  Applies to plain FM; field-aware FM (field_num > 0)
+    # always uses its closed-form op (ops.interaction.ffm_interaction;
+    # FAST_TFFM_FFM_AUTODIFF=1 forces the autodiff einsum oracle).
     interaction: str = ""
     # Sparse row updates (IndexedSlices-style): optimizer touches only the
     # rows in the batch. Falls back to dense when the optimizer/l2_mode
