@@ -162,6 +162,42 @@ def main() -> int:
         f"{t['scatter_adagrad_apply'] / t['tile_adagrad_apply']:.1f}x"
     )
 
+    # Compact K2 A/B (small batch): with 900 ids (-> 1024 padded
+    # entries) the touched-group grid covers at most half of V=2^22's
+    # 2048 groups, so FAST_TFFM_K2_COMPACT's auto heuristic would
+    # engage — this measures whether touched-only streaming wins on
+    # real DMA behavior (TPU_STATUS.md round-5 measurement list) and
+    # verifies both paths agree on chip.  Fail-soft like the sweep.
+    try:
+        ids_small = jax.device_put(
+            jnp.asarray(rng.integers(0, V, (900,)), jnp.int32))
+        g_small = jax.device_put(
+            jnp.asarray(rng.uniform(-1, 1, (900, D)), jnp.float32))
+        fns = {
+            compact: jax.jit(
+                lambda tb, a, i, gg, c=compact: sparse_apply.adagrad_apply(
+                    tb, a, i, gg, lr=lr, eps=eps, compact=c))
+            for compact in (False, True)
+        }
+        # Parity first, outputs freed BEFORE timing (the sweep's rule:
+        # extra (V, D) arrays held across a bench can OOM / skew it).
+        outs = {c: fn(table, acc, ids_small, g_small)
+                for c, fn in fns.items()}
+        err_c = max(
+            float(jnp.max(jnp.abs(a_ - b_)))
+            for a_, b_ in zip(outs[False], outs[True])
+        )
+        del outs
+        flag = "" if err_c < 1e-4 else "  WRONG"
+        emit(f"  compact parity err {err_c:.2e}{flag}")
+        for compact, fn in fns.items():
+            ms_c = bench(fn, table, acc, ids_small, g_small)
+            emit(f"  small-batch apply compact={int(compact)}: "
+                 f"{ms_c:9.3f} ms")
+    except Exception as exc:  # noqa: BLE001 — must not kill the window
+        emit(f"  compact A/B FAILED: {type(exc).__name__}: "
+             f"{str(exc).splitlines()[0][:150]}")
+
     if args.sweep_blocks:
         # K1 runs N/CHUNK sequential grid steps (per-step overhead) with
         # one-hot matmul work growing ~CHUNK per occurrence; K2's TILE
