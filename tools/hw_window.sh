@@ -31,6 +31,14 @@ while true; do
     echo "[hw_window] TUNNEL UP $(date -u +%FT%TZ) — running sequence"
     # 1. Official bench first (watchdog-protected internally).
     python bench.py | tee /tmp/bench_r05_builder.out
+    # A tunnel that died between the probe and the bench leaves a CPU
+    # fallback line — that window is LOST, not done: resume polling
+    # instead of consuming our one shot on a CPU artifact.
+    if tail -n 1 /tmp/bench_r05_builder.out | \
+        grep -q '"platform": "cpu"'; then
+      echo "[hw_window] bench fell back to CPU; window lost — resuming"
+      continue
+    fi
     # Only commit the artifact if the last line is actual JSON (a hung/
     # failed bench leaves an error string there instead).
     if tail -n 1 /tmp/bench_r05_builder.out | python -c \
