@@ -7,6 +7,8 @@ duplicates, FTRL's single -sigma*w correction per row, and correct
 handling of hot ids whose occurrence runs span many K1 chunks.
 """
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -449,7 +451,7 @@ def test_unique_entries_and_merge_match_dense_delta():
     # Apply both deltas with SGD (linear in g1: exposes placement errors).
     table = jnp.zeros((vocab, D), jnp.float32)
     (t_entries,) = sparse_apply.k2_apply(
-        __import__("functools").partial(sparse_apply.sgd_update, lr=1.0),
+        functools.partial(sparse_apply.sgd_update, lr=1.0),
         ts, u, (table,),
     )
     t_dense = -dense_sum[:, :D]
