@@ -541,6 +541,11 @@ def resolve_exchange(mode: str, *, n_local_occ: int, vocab_local: int,
     """
     if mode != "auto":
         return mode
+    if data_shards == 1:
+        # Nothing to exchange either way; entries' fast path is then the
+        # plain single-device K1+K2 apply, strictly less work than
+        # materializing and elementwise-applying a dense delta.
+        return "entries"
     cap = entries_cap(n_local_occ, vocab_local)
     entries_words = data_shards * cap * (2 * d + 1)
     dense_words = vocab_local * 2 * d
@@ -626,13 +631,23 @@ def k2_apply(update, tile_start, u, tables, compact=None):
                     compact=compact)
 
 
-def entries_exchange(lids, g_rows, *, vocab_local, data_axis):
+def entries_exchange(lids, g_rows, *, vocab_local, data_axis,
+                     data_shards):
     """The ONE copy of the entries-exchange protocol (shard_map body):
     dedupe LOCAL-coordinate occurrences (off-shard ids pre-mapped to the
     sentinel ``vocab_local``, their payloads zeroed), all-gather the
     touched-entry streams over ``data_axis``, merge.  Returns the
     K2-ready ``(u, tile_start)``.  Both the shardmap step and the GSPMD
-    sharded apply call this — keep it the only copy."""
+    sharded apply call this — keep it the only copy.
+
+    ``data_shards`` (static) short-circuits the degenerate pure
+    model-parallel case: with one data shard there is nothing to
+    exchange, and the single-device dedup already produces the K2
+    stream — the gather + second sort + second K1 pass would only
+    re-derive it.
+    """
+    if data_shards == 1:
+        return _dedup_and_starts(lids, g_rows, vocab_local)
     cap = entries_cap(lids.shape[0], vocab_local)
     rows_e, pay_e, _ = unique_entries(
         lids, g_rows, vocab=vocab_local, cap=cap
@@ -894,7 +909,7 @@ def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
             g_masked = jnp.where(in_range[:, None], g_l, 0.0)
             u2, ts2 = entries_exchange(
                 lids, g_masked, vocab_local=vocab_local,
-                data_axis=data_axis,
+                data_axis=data_axis, data_shards=mesh.shape[data_axis],
             )
             # k2_apply expects update -> tuple; the single-table (sgd)
             # wrapper returns a bare array.

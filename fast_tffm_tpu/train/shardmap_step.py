@@ -258,6 +258,7 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
             u2, ts2 = sparse_apply.entries_exchange(
                 ids_flat.astype(jnp.int32), g_flat,
                 vocab_local=vocab_local, data_axis=DATA_AXIS,
+                data_shards=mesh.shape[DATA_AXIS],
             )
             w_new, new_tables = _apply_stream(
                 cfg, ts2, u2, table_l, opt_tables_l

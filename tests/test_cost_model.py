@@ -183,3 +183,18 @@ def test_compact_k2_grid_scales_with_entries_not_vocab():
     n_groups4 = (vocab * 4) // (sparse_apply.TILE * sparse_apply._group_for(
         (vocab * 4) // sparse_apply.TILE))
     assert n_groups4 not in grids4, (grids4, n_groups4)
+
+
+def test_auto_exchange_pure_model_parallel():
+    """With one data shard nothing is exchanged either way; auto must
+    pick entries (its fast path is the plain K1+K2 apply, strictly less
+    work than a dense delta materialization)."""
+    assert sparse_apply.resolve_exchange(
+        "auto", n_local_occ=10_000, vocab_local=1 << 12, d=9,
+        data_shards=1,
+    ) == "entries"
+    # Same shapes with real data sharding still favor dense.
+    assert sparse_apply.resolve_exchange(
+        "auto", n_local_occ=10_000, vocab_local=1 << 12, d=9,
+        data_shards=4,
+    ) == "dense"

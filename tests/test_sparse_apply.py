@@ -227,7 +227,7 @@ def test_supports_tile_gating():
 
 
 @pytest.mark.parametrize("exchange", ["dense", "entries"])
-@pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4)])
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4), (1, 8)])
 def test_adagrad_sharded_matches_scatter(shape, exchange):
     """Sharded tile apply on a (data, model) virtual mesh == scatter,
     for both the dense-delta psum and the batch-proportional entries
