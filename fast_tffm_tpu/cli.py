@@ -45,6 +45,17 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="host:port of process 0 (multi-host)")
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
+    # Dispatch/transfer knobs (override the cfg file; the INI keys of the
+    # same name are the durable spelling).
+    p.add_argument(
+        "--steps_per_dispatch", type=int, default=None,
+        help="train K batches per device dispatch via a fused lax.scan "
+             "(1 = classic per-batch dispatch)",
+    )
+    p.add_argument(
+        "--prefetch_super_batches", type=int, default=None,
+        help="stacked super-batches the transfer stage keeps in flight",
+    )
     # Legacy reference flags (mapped, SURVEY.md §3.2).
     p.add_argument("--ps_hosts", default=None, help="legacy; ps tasks exit")
     p.add_argument("--worker_hosts", default=None,
@@ -87,7 +98,12 @@ def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
     from fast_tffm_tpu.config import load_config
 
-    cfg = load_config(args.cfg)
+    overrides = {
+        key: getattr(args, key)
+        for key in ("steps_per_dispatch", "prefetch_super_batches")
+        if getattr(args, key) is not None
+    }
+    cfg = load_config(args.cfg, overrides or None)
     _setup_logging(cfg.log_file or None)
     dist = _resolve_dist(args)
     if dist is not None:

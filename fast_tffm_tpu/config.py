@@ -150,6 +150,18 @@ class FmConfig:
     # (sparse-friendly); "full" regularizes the whole table (dense grads,
     # only sane for small vocabularies).
     l2_mode: str = "batch"
+    # Device-resident multi-step training: one dispatch trains this many
+    # batches via jax.lax.scan over a stacked super-batch — no Python or
+    # host round-trip between the K steps.  1 = the classic one dispatch
+    # per batch.  Logging / validation / save / profiler cadences and the
+    # checkpointed mid-epoch position all move to super-batch granularity
+    # (a resume always lands on a super-batch boundary).
+    steps_per_dispatch: int = 1
+    # How many stacked super-batches the transfer stage keeps in flight:
+    # super-batch n+1 is stacked and shipped (shard_batch/device_put) on a
+    # background thread while n trains.  Bounds host+device memory for
+    # staged input at prefetch_super_batches * steps_per_dispatch batches.
+    prefetch_super_batches: int = 2
     # How multi-device sparse updates are exchanged over the data axis
     # (both the shardmap step and the GSPMD sharded tile apply; the
     # reference's IndexedSlices push, SURVEY.md §3.2): "dense" psums
@@ -183,6 +195,15 @@ class FmConfig:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.interaction not in ("", "pallas", "jnp", "flat"):
             raise ValueError(f"unknown interaction {self.interaction!r}")
+        if self.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got {self.steps_per_dispatch}"
+            )
+        if self.prefetch_super_batches < 1:
+            raise ValueError(
+                "prefetch_super_batches must be >= 1, got "
+                f"{self.prefetch_super_batches}"
+            )
         if self.weight_files and len(self.weight_files) != len(self.train_files):
             raise ValueError(
                 "weight_files must parallel train_files "
@@ -268,6 +289,8 @@ _KEYMAP = {
     "host_sort": ("host_sort", _parse_bool),
     "l2_mode": ("l2_mode", str),
     "sparse_exchange": ("sparse_exchange", str),
+    "steps_per_dispatch": ("steps_per_dispatch", int),
+    "prefetch_super_batches": ("prefetch_super_batches", int),
 }
 
 

@@ -22,6 +22,17 @@ from fast_tffm_tpu.data.libsvm import Batch
 
 log = logging.getLogger(__name__)
 
+
+class OutOfRangeIdsError(ValueError):
+    """Batch ids fall outside [0, vocabulary_size).
+
+    This is a data / vocabulary_size integrity bug, not a transient
+    native failure: the device-sort fallback would silently drop updates
+    for the out-of-range ids, so callers must keep surfacing it instead
+    of degrading once and going quiet (ADVICE r5).
+    """
+
+
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "_src")
 _SRC = os.path.join(_SRC_DIR, "fm_parser.cc")
 _LIB = os.path.join(_SRC_DIR, "libfm_parser.so")
@@ -125,6 +136,18 @@ def sort_meta(ids, vocab: int, chunk: int, tile: int):
     lib = _load()
     ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int32)
     n = ids.shape[0]
+    if n:
+        # The C++ side also rejects out-of-range ids (it would corrupt
+        # its bucket scatter) but folds them into the same rc as bad
+        # arguments; pre-checking here gives the caller a typed error to
+        # tell the integrity bug apart from a transient failure.
+        lo, hi = int(ids.min()), int(ids.max())
+        if lo < 0 or hi >= vocab:
+            raise OutOfRangeIdsError(
+                f"out-of-range batch ids (outside [0, {vocab})): "
+                f"min={lo} max={hi} — input data and vocabulary_size "
+                "disagree"
+            )
     n_pad = -(-n // chunk) * chunk
     n_chunks = n_pad // chunk
     n_tiles = vocab // tile

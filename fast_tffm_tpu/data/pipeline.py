@@ -260,6 +260,8 @@ class BatchPipeline:
         skip_batches: int = 0,
         shard: tuple[int, int] = (0, 1),
         sort_meta_spec=None,
+        cache_epochs: bool = False,
+        cache_max_bytes: int = 1 << 30,
     ):
         self.files = list(files)
         self.cfg = cfg
@@ -304,6 +306,23 @@ class BatchPipeline:
             cfg.fast_ingest and self._native is not None
             and not self.weight_files
         )
+        # Multi-epoch parsed-batch cache (the tf.data ``.cache()``
+        # pattern): epoch 0 parses normally while retaining every
+        # delivered Batch; epochs 1..E-1 replay the cached batches in a
+        # seeded per-epoch permutation instead of re-reading and
+        # re-parsing the same text.  Batch contents are preserved exactly
+        # (so attached sort_meta stays valid); cross-epoch remixing drops
+        # to batch granularity — the documented tradeoff, opt-in only.
+        # Engages only in the simple streaming case; a byte budget guards
+        # host memory (overflow falls back to re-parsing).
+        self._cache_epochs = (
+            cache_epochs and epochs > 1 and skip_batches == 0
+            and shard == (0, 1)
+        )
+        self._cache_max_bytes = cache_max_bytes
+        # Outcome of the cache for observability: "off" | "cached" |
+        # "overflow" (budget blown mid-epoch-0; later epochs re-parsed).
+        self.cache_result = "off"
 
     @property
     def truncated_features(self) -> int:
@@ -313,6 +332,43 @@ class BatchPipeline:
         return self._native.truncated_features if self._native else 0
 
     def __iter__(self) -> Iterator[libsvm.Batch]:
+        if not self._cache_epochs:
+            yield from self._iter_stream(self.epochs)
+            return
+        cache: Optional[list] = []
+        size = 0
+        self.cache_result = "cached"
+        for batch in self._iter_stream(1):
+            if cache is not None:
+                arrays = [batch.labels, batch.ids, batch.vals,
+                          batch.fields, batch.weights]
+                if batch.sort_meta is not None:
+                    arrays.extend(batch.sort_meta)  # ~doubles a batch
+                size += sum(a.nbytes for a in arrays)
+                if size > self._cache_max_bytes:
+                    log.info(
+                        "ingest cache over budget (%d > %d bytes); "
+                        "re-parsing later epochs", size,
+                        self._cache_max_bytes,
+                    )
+                    cache = None
+                    self.cache_result = "overflow"
+                else:
+                    cache.append(batch)
+            yield batch
+        if cache is None:  # budget blown: stream the remaining epochs
+            yield from self._iter_stream(self.epochs - 1, first_epoch=1)
+            return
+        for epoch in range(1, self.epochs):
+            order = list(range(len(cache)))
+            if self.shuffle:
+                random.Random(self.seed + epoch).shuffle(order)
+            for i in order:
+                yield cache[i]
+
+    def _iter_stream(
+        self, n_epochs: int, first_epoch: int = 0
+    ) -> Iterator[libsvm.Batch]:
         cfg = self.cfg
         work: queue.Queue = queue.Queue(maxsize=max(2, cfg.queue_size))
         out: queue.Queue = queue.Queue(maxsize=max(2, cfg.queue_size))
@@ -370,7 +426,7 @@ class BatchPipeline:
         def reader():
             try:
                 seq = 0
-                for epoch in range(self.epochs):
+                for epoch in range(first_epoch, first_epoch + n_epochs):
                     rng = random.Random(self.seed + epoch)
                     to_skip = self.skip_batches if epoch == 0 else 0
                     if self._raw:
@@ -433,13 +489,26 @@ class BatchPipeline:
                         # requirement: the device-sort path handles
                         # sort_meta=None.  A native failure here must
                         # degrade, not kill the epoch — same contract as
-                        # Trainer._put's fallback, including disabling
-                        # the spec so later batches skip the doomed call.
+                        # Trainer._put's fallback.  But the two failure
+                        # classes degrade differently (ADVICE r5):
+                        # out-of-range ids are a data/vocabulary_size
+                        # integrity bug whose updates the device path
+                        # SILENTLY drops, so that warning repeats per bad
+                        # batch; any other native failure disables the
+                        # spec once and goes quiet.
                         try:
                             batch = batch._replace(
                                 sort_meta=_native.sort_meta(
                                     batch.ids, *self._sort_meta_spec
                                 )
+                            )
+                        except _native.OutOfRangeIdsError as e:
+                            log.warning(
+                                "host sort_meta rejected a batch (%s); "
+                                "the input data or vocabulary_size is "
+                                "wrong — the device-sort path will "
+                                "silently drop updates for ids >= "
+                                "vocabulary_size", e,
                             )
                         except Exception as e:
                             self._sort_meta_spec = None
@@ -448,11 +517,7 @@ class BatchPipeline:
                                 log.warning(
                                     "host sort_meta failed (%s: %s); "
                                     "falling back to device sort for the "
-                                    "rest of the run.  If the error names "
-                                    "out-of-range ids, the input data or "
-                                    "vocabulary_size is wrong — the device "
-                                    "path will silently drop updates for "
-                                    "ids >= vocabulary_size.",
+                                    "rest of the run",
                                     type(e).__name__, e,
                                 )
                 except BaseException as e:
@@ -505,6 +570,137 @@ class BatchPipeline:
                         except queue.Empty:
                             pass
                     t.join(timeout=0.05)
+
+
+def stack_batches(batches: Sequence[libsvm.Batch]) -> libsvm.Batch:
+    """Stack K parsed batches into one [K, batch, ...] super-batch.
+
+    The stacked Batch feeds the K-step scan train step (train.loop.
+    make_scan_train_step), which consumes the leading axis one step at a
+    time.  Host-computed ``sort_meta`` rides along leaf-wise when EVERY
+    batch carries it (shapes agree by construction: all meta derives from
+    the same (batch_size * max_features, CHUNK, TILE, vocab)); a group
+    with any meta-less batch drops it entirely — the device-sort path
+    handles meta-less batches, and a per-step mix would change the scan
+    xs pytree mid-run.
+    """
+    if not batches:
+        raise ValueError("stack_batches needs at least one batch")
+    if len(batches) == 1:  # K=1 (or an epoch tail of 1): zero-copy views
+        b = batches[0]
+        meta = b.sort_meta
+        if meta is not None:
+            meta = type(meta)(*(x[None] for x in meta))
+        return libsvm.Batch(
+            b.labels[None], b.ids[None], b.vals[None], b.fields[None],
+            b.weights[None], sort_meta=meta,
+        )
+    core = (
+        np.stack([b.labels for b in batches]),
+        np.stack([b.ids for b in batches]),
+        np.stack([b.vals for b in batches]),
+        np.stack([b.fields for b in batches]),
+        np.stack([b.weights for b in batches]),
+    )
+    metas = [b.sort_meta for b in batches]
+    meta = None
+    if all(m is not None for m in metas):
+        meta = type(metas[0])(*(np.stack(cols) for cols in zip(*metas)))
+    return libsvm.Batch(*core, sort_meta=meta)
+
+
+class DevicePrefetcher:
+    """Double-buffered transfer stage between BatchPipeline and the loop.
+
+    A background thread pulls parsed batches from ``source``, stacks
+    ``steps_per_dispatch`` of them into a [K, ...] super-batch
+    (:func:`stack_batches`, carrying host ``sort_meta``), and ships it to
+    the device with ``put_fn`` (shard + device_put; the dispatch is
+    async, so super-batch n+1's H2D copies overlap super-batch n's
+    training).  At most ``depth`` shipped super-batches wait in the
+    bounded output queue — host/device memory for staged input stays
+    capped at ~(depth + 1) super-batches.  The source's tail yields a
+    short super-batch at K' = leftover.
+
+    Iterating yields ``(device_super_batch, n_batches)``.  Exceptions
+    from the source or the transfer re-raise in the consumer; ``close()``
+    stops the thread and is idempotent (iteration calls it on exit).
+    """
+
+    def __init__(self, source, steps_per_dispatch: int, put_fn,
+                 depth: int = 2):
+        self._k = max(1, steps_per_dispatch)
+        self._put_fn = put_fn
+        self._out: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(source),), daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, it):
+        try:
+            group: list = []
+            while not self._stop.is_set():
+                batch = next(it, _SENTINEL)
+                if batch is _SENTINEL:
+                    break
+                group.append(batch)
+                if len(group) == self._k:
+                    if not self._emit(group):
+                        return
+                    group = []
+            if group and not self._stop.is_set():
+                self._emit(group)  # epoch tail: K' = leftover
+        except BaseException as e:  # surfaces in the consumer
+            self._offer(_Error(e))
+        finally:
+            self._offer(_SENTINEL)
+            # Deterministically release the source's own resources (a
+            # BatchPipeline generator holds parser threads + queues).
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # pragma: no cover - best effort
+                    pass
+
+    def _emit(self, group) -> bool:
+        dev = self._put_fn(stack_batches(group))
+        return self._offer((dev, len(group)))
+
+    def _offer(self, item) -> bool:
+        """Bounded put that gives up once the consumer is gone."""
+        while not self._stop.is_set():
+            try:
+                self._out.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._out.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, _Error):
+                    raise item.exc
+                yield item
+        finally:
+            self.close()
+
+    def close(self):
+        """Stop the transfer thread and reap it (idempotent)."""
+        self._stop.set()
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._out.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
 
 
 def _make_parser(cfg: FmConfig):

@@ -127,8 +127,10 @@ def fm_interaction_sharded(rows, vals, use_pallas, mesh, data_axis: str):
         return fm_interaction(rows, vals, impl)
     from jax.sharding import PartitionSpec as P
 
+    from fast_tffm_tpu.platform import shard_map
+
     # check_vma=False: pallas_call out_shapes don't carry vma annotations.
-    return jax.shard_map(
+    return shard_map(
         lambda r, v: fm_interaction(r, v, "pallas"),
         mesh=mesh,
         in_specs=(P(data_axis, None, None), P(data_axis, None)),

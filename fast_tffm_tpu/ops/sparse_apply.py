@@ -536,8 +536,17 @@ def resolve_exchange(mode: str, *, n_local_occ: int, vocab_local: int,
     the deduped touched-row streams — bytes grow with the batch,
     independent of vocab (the reference PS design's IndexedSlices
     scaling, SURVEY.md §3.2).  "auto" picks whichever moves fewer
-    words per device (psum and all-gather have comparable per-word
-    ring cost on ICI).
+    words per device over a ring:
+
+      entries  S-shard all-gather of cap*(2D+1) words:
+               (S-1) * cap * (2D+1) per device,
+      dense    ring all-reduce of vocab_local*2D words (reduce-scatter
+               + all-gather phases): 2 * vocab_local*2D * (S-1)/S.
+
+    Dropping the common (S-1) factor gives the comparison below; the
+    dense side carries the all-reduce's 2x buffer traffic (ADVICE r5 —
+    the unweighted comparison was ~2x biased toward 'dense' and could
+    pick the slower exchange near the crossover).
     """
     if mode != "auto":
         return mode
@@ -548,7 +557,7 @@ def resolve_exchange(mode: str, *, n_local_occ: int, vocab_local: int,
         return "entries"
     cap = entries_cap(n_local_occ, vocab_local)
     entries_words = data_shards * cap * (2 * d + 1)
-    dense_words = vocab_local * 2 * d
+    dense_words = 2 * vocab_local * 2 * d
     return "entries" if entries_words < dense_words else "dense"
 
 
@@ -926,7 +935,9 @@ def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
         dense = jax.lax.psum(dense, data_axis)
         return update_fn(dense[:, :d], dense[:, d:], *tables_l)
 
-    return jax.shard_map(
+    from fast_tffm_tpu.platform import shard_map
+
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(data_axis), P(data_axis, None))

@@ -72,6 +72,24 @@ def batch_sharding(mesh: Mesh):
     }
 
 
+def super_batch_sharding(mesh: Mesh):
+    """Sharding for a stacked [K, ...] super-batch: the leading scan axis
+    is replicated (every device steps through all K slices), the batch
+    axis behind it shards over `data` exactly like a single batch.
+
+    Returns a dict keyed like data.libsvm.Batch fields.
+    """
+    ex = NamedSharding(mesh, P(None, DATA_AXIS))
+    feat = NamedSharding(mesh, P(None, DATA_AXIS, None))
+    return {
+        "labels": ex,
+        "ids": feat,
+        "vals": feat,
+        "fields": feat,
+        "weights": ex,
+    }
+
+
 def shard_params(params: FmParams, mesh: Mesh) -> FmParams:
     sh = param_sharding(mesh)
     return jax.tree.map(jax.device_put, params, sh)
@@ -134,6 +152,43 @@ def shard_batch(batch, mesh: Mesh):
         # Host sort-meta describes one process's local ids; it cannot be
         # assembled into a global batch (the producer never attaches it
         # multi-process, so this is just defensive).
+        return type(batch)(
+            *(put(getattr(batch, k), sh[k]) for k in core), sort_meta=None
+        )
+    if meta is not None:
+        rep = NamedSharding(mesh, P())
+        meta = type(meta)(*(jax.device_put(x, rep) for x in meta))
+    return type(batch)(
+        *(jax.device_put(getattr(batch, k), sh[k]) for k in core),
+        sort_meta=meta,
+    )
+
+
+def shard_super_batch(batch, mesh: Mesh):
+    """Ship a stacked [K, batch, ...] super-batch to the mesh.
+
+    Same contract as :func:`shard_batch` with a leading scan axis: the K
+    axis is replicated, the batch axis shards over `data`.  Multi-process,
+    ``batch`` holds this process's local slice on axis 1 and the global
+    array is assembled without any host materializing the global batch.
+    ``device_put`` is async, so calling this from a transfer thread
+    overlaps the H2D copies with the previous super-batch's training.
+    """
+    sh = super_batch_sharding(mesh)
+    core = ("labels", "ids", "vals", "fields", "weights")
+    meta = getattr(batch, "sort_meta", None)
+    if jax.process_count() > 1:
+        _, num_blocks = data_partition(mesh)
+
+        def put(x, s):
+            x = np.asarray(x)
+            global_shape = (
+                x.shape[0], x.shape[1] * num_blocks
+            ) + x.shape[2:]
+            return jax.make_array_from_process_local_data(s, x, global_shape)
+
+        # Host sort-meta is per-process-local (see shard_batch): never
+        # assembled multi-process.
         return type(batch)(
             *(put(getattr(batch, k), sh[k]) for k in core), sort_meta=None
         )

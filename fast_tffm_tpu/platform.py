@@ -73,6 +73,29 @@ def force_compiled():
         _force_compiled = prev
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions — the ONE compat gate.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=...)``; older
+    releases only have ``jax.experimental.shard_map.shard_map`` whose
+    equivalent knob is ``check_rep``.  All shard_map call sites in this
+    package route through here so a version bump is a one-line change.
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def ffm_compute_dtype(compute_dtype):
     """The dtype FFM's einsum operands may use on the current target.
 

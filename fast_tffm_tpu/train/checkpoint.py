@@ -56,9 +56,14 @@ def save(
         if opt_state is not None:
             ckptr.save(_opt_dir(model_file), {"opt_state": opt_state}, force=True)
     if data_state is not None:
-        # Input-pipeline position (epoch, batches consumed) for mid-epoch
-        # resume; written last so a crash mid-save leaves the (older)
-        # params without a newer data position.
+        # Input-pipeline position for mid-epoch resume; written last so a
+        # crash mid-save leaves the (older) params without a newer data
+        # position.  Schema (written by Trainer.save): ``epoch``,
+        # ``batches_done`` — batches TRAINED, advanced only by whole
+        # K-step dispatches, so the position always names a super-batch
+        # boundary (staged-but-untrained prefetches re-parse on resume) —
+        # and ``fingerprint``, the input-stream identity that gates
+        # whether the position is honored (Trainer._data_fingerprint).
         tmp = _data_state_path(model_file) + ".tmp"
         with open(tmp, "w") as f:
             json.dump(data_state, f)

@@ -9,6 +9,14 @@ hogwild PS training (SURVEY.md §7 step 4 notes the convergence difference).
 Host-side, batches parse on background threads (data.pipeline) while the
 device runs the current step; the donated carry keeps the step fully
 async-dispatched.
+
+The hot loop is device-resident: ``steps_per_dispatch`` (K) parsed batches
+stack into one [K, ...] super-batch, a transfer thread ships super-batch
+n+1 (DevicePrefetcher) while n trains, and ONE dispatch of the
+``lax.scan``-fused step (make_scan_train_step) trains all K with no
+Python/host round-trips in between.  Logging / validation / save /
+profiler cadences and the checkpointed mid-epoch position advance at
+K-step granularity; a resume always lands on a super-batch boundary.
 """
 
 from __future__ import annotations
@@ -26,7 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.libsvm import Batch
-from fast_tffm_tpu.data.pipeline import BatchPipeline
+from fast_tffm_tpu.data.pipeline import BatchPipeline, DevicePrefetcher
 from fast_tffm_tpu.models import fm
 from fast_tffm_tpu.parallel import mesh as mesh_lib
 from fast_tffm_tpu.train import checkpoint, metrics as metrics_lib
@@ -140,6 +148,30 @@ def make_sparse_train_step(cfg: FmConfig, mesh=None):
         return TrainState(params, opt_state, ms, state.step + 1)
 
     return step
+
+
+def make_scan_train_step(step_fn):
+    """Wrap a (state, batch) -> state train step in ``jax.lax.scan`` over
+    a stacked super-batch: ONE dispatch trains K steps with zero
+    intervening Python/host round-trips (the device-resident hot loop the
+    reference built queue-runners for, PAPER.md §2 #6).
+
+    The carry is the TrainState (donated at the jit boundary); xs is a
+    Batch whose every leaf carries a leading K axis — including stacked
+    host ``sort_meta``, so the per-step tile apply still skips its
+    on-device sort.  K is baked into the trace: the jitted wrapper
+    retraces per distinct K, so an epoch tail at K' = leftover costs one
+    extra compile the first time that K' appears.
+    """
+
+    def scan_step(state: TrainState, batches: Batch) -> TrainState:
+        def body(carry, batch):
+            return step_fn(carry, batch), None
+
+        state, _ = jax.lax.scan(body, state, batches)
+        return state
+
+    return scan_step
 
 
 def make_eval_step(cfg: FmConfig):
@@ -258,6 +290,18 @@ class Trainer:
             out_shardings=state_sh,
             donate_argnums=0,
         )
+        # K-step fused dispatch: the same step_fn under lax.scan over a
+        # stacked [K, ...] super-batch.  train() always dispatches through
+        # this (steps_per_dispatch == 1 is a scan of length 1, numerically
+        # identical to the single step); _train_step stays for direct
+        # single-batch callers (bench step-only mode, tests).
+        self._super_batch_sh = Batch(**mesh_lib.super_batch_sharding(self.mesh))
+        self._scan_train_step = jax.jit(
+            make_scan_train_step(step_fn),
+            in_shardings=(state_sh, self._super_batch_sh),
+            out_shardings=state_sh,
+            donate_argnums=0,
+        )
         ms_sh = jax.tree.map(lambda _: rep, MetricState.zeros())
         self._eval_step = jax.jit(
             make_eval_step(cfg),
@@ -298,11 +342,53 @@ class Trainer:
             opt_state = checkpoint.restore_opt(cfg.model_file, opt_template)
             if opt_state is None:
                 opt_state = opt_init(params)
+            elif self.sparse and cfg.optimizer == "ftrl":
+                params = self._check_ftrl_invariant(params, opt_state)
             return params, opt_state
         self._restored_step = 0
         init = jax.jit(partial(fm.init_params, cfg=cfg), out_shardings=param_sh)
         params = init(jax.random.PRNGKey(cfg.seed))
         return params, opt_init(params)
+
+    def _check_ftrl_invariant(self, params, opt_state):
+        """Enforce the FTRL closed-form invariant on a warm start.
+
+        Every sparse FTRL path maintains ``w == ftrl_solve(z, n)``, and
+        the compact-K2 tile apply RELIES on it: compact sweeps skip
+        untouched rows while the full sweep recomputes them, and the two
+        only agree because recompute == stored value (ops.sparse_apply.
+        ftrl_apply).  A checkpoint whose table was edited outside
+        train.sparse would otherwise drift silently, sweep-dependently.
+        Restore-time normalization makes the violation loud and fixes it:
+        ``w = ftrl_solve(z, n)`` is a no-op for invariant-respecting
+        checkpoints (our own, and fresh z inits) and canonicalizes the
+        rest.
+        """
+        cfg = self.cfg
+        solve = jax.jit(
+            partial(
+                sparse_lib.sparse_apply.ftrl_solve,
+                lr=cfg.learning_rate, l1=cfg.ftrl_l1, l2=cfg.ftrl_l2,
+                beta=cfg.ftrl_beta,
+            )
+        )
+        expect = fm.FmParams(
+            w0=solve(opt_state.z.w0, opt_state.n.w0),
+            table=solve(opt_state.z.table, opt_state.n.table),
+        )
+        dev = max(
+            float(jnp.max(jnp.abs(expect.w0 - params.w0))),
+            float(jnp.max(jnp.abs(expect.table - params.table))),
+        )
+        if dev <= 1e-6:
+            return params  # invariant holds; keep the restored bits
+        log.warning(
+            "warm-started FTRL params violate w == ftrl_solve(z, n) "
+            "(max |dev| %.3g) — the table was edited outside train.sparse. "
+            "Normalizing w = ftrl_solve(z, n) so the compact-K2 apply "
+            "stays sweep-independent.", dev,
+        )
+        return expect
 
     def _put(self, batch: Batch, want_meta: bool = True) -> Batch:
         spec = self._sort_meta_spec() if want_meta else None
@@ -313,6 +399,15 @@ class Trainer:
                 batch = batch._replace(
                     sort_meta=native_mod.sort_meta(batch.ids, *spec)
                 )
+            except native_mod.OutOfRangeIdsError as e:
+                # Data/vocabulary_size integrity bug — same policy as the
+                # pipeline workers: warn EVERY bad batch and keep the
+                # spec (the device-sort path silently drops updates for
+                # out-of-range ids, so this must not go quiet).
+                log.warning(
+                    "host sort_meta rejected a batch (%s); the input "
+                    "data or vocabulary_size is wrong", e,
+                )
             except Exception as e:
                 # Lib unavailable (no g++?) or a real sort_meta bug: the
                 # device-sort path is always correct, so train on — but
@@ -322,6 +417,14 @@ class Trainer:
                 )
                 self._meta_spec = None
         return mesh_lib.shard_batch(batch, self.mesh)
+
+    def _put_super(self, batch: Batch) -> Batch:
+        """Ship a stacked [K, ...] super-batch — DevicePrefetcher's put_fn,
+        called from the transfer thread so the H2D copies overlap the
+        previous super-batch's training.  Host sort_meta is attached by
+        the pipeline workers (sort_meta_spec); no fallback computation
+        here — a meta-less stack trains through the device-sort path."""
+        return mesh_lib.shard_super_batch(batch, self.mesh)
 
     def _sort_meta_spec(self):
         """(vocab, CHUNK, TILE) when host-side sort prep applies, else None.
@@ -428,9 +531,17 @@ class Trainer:
         )
         pipe_cfg, shard, _ = self._input_plan()
         profiling = False
+        profile_started = False
+        profile_stop_at = 0
+        k = cfg.steps_per_dispatch
         t0 = time.time()
         last_log_t, last_log_ex = t0, 0.0
         stepno = 0
+        # Cadences move to super-batch (K-step) granularity: a trigger
+        # fires at the first dispatch boundary where at least its period
+        # of NEW steps has elapsed since it last fired.  At K == 1 this
+        # reduces exactly to the old per-step ``stepno % period == 0``.
+        last_log_step = last_val_step = last_save_step = 0
         trunc_base, trunc_logged = 0, 0
         try:
             for epoch in range(resume_epoch, cfg.epoch_num):
@@ -455,77 +566,114 @@ class Trainer:
                     ordered=True,
                     sort_meta_spec=self._sort_meta_spec(),
                 )
-                for batch in pipeline:
-                    if cfg.profile_dir and stepno == cfg.profile_start_step:
-                        jax.profiler.start_trace(cfg.profile_dir)
-                        profiling = True
-                    self.state = self._train_step(self.state, self._put(batch))
-                    stepno += 1
-                    self._batches_done += 1
-                    if profiling and stepno >= (
-                        cfg.profile_start_step + cfg.profile_steps
-                    ):
-                        jax.block_until_ready(self.state)
-                        jax.profiler.stop_trace()
-                        profiling = False
-                        log.info("profiler trace written to %s", cfg.profile_dir)
-                    if cfg.log_steps and stepno % cfg.log_steps == 0:
-                        # Examples come from the on-device weight sum — the
-                        # GLOBAL count in multi-host runs (each host only
-                        # sees its local shard).
-                        m = _finalize_metrics(self.state.metrics, cfg.loss_type)
-                        now = time.time()
-                        rate = (m["examples"] - last_log_ex) / max(
-                            now - last_log_t, 1e-9
+                # Transfer stage: a background thread stacks K parsed
+                # batches and ships super-batch n+1 (shard + device_put)
+                # while n trains; the epoch tail arrives as one short
+                # super-batch (K' = leftover), so every batch trains
+                # exactly once and ``batches_done`` only ever advances by
+                # whole dispatches — a saved position always lands on a
+                # super-batch boundary.
+                prefetcher = DevicePrefetcher(
+                    pipeline, k, self._put_super,
+                    depth=cfg.prefetch_super_batches,
+                )
+                try:
+                    for super_batch, kk in prefetcher:
+                        if (
+                            cfg.profile_dir
+                            and not profile_started
+                            and stepno >= cfg.profile_start_step
+                        ):
+                            jax.profiler.start_trace(cfg.profile_dir)
+                            profiling = profile_started = True
+                            profile_stop_at = stepno + cfg.profile_steps
+                        # ONE dispatch = kk fused train steps (lax.scan).
+                        self.state = self._scan_train_step(
+                            self.state, super_batch
                         )
-                        last_log_t, last_log_ex = now, m["examples"]
-                        log.info(
-                            "step %d examples %d loss %.6f auc %.4f ex/s %.0f",
-                            stepno, int(m["examples"]), m["loss"], m["auc"],
-                            rate,
-                        )
-                        # Surface parser truncation (reference FmParser
-                        # warned; silently vanishing features hide data
-                        # bugs like a too-small max_features).
-                        cur_trunc = trunc_base + pipeline.truncated_features
-                        if cur_trunc > trunc_logged:
-                            log.warning(
-                                "%d feature occurrences dropped by "
-                                "max_features=%d since last report "
-                                "(total %d)",
-                                cur_trunc - trunc_logged, cfg.max_features,
-                                cur_trunc,
+                        stepno += kk
+                        self._batches_done += kk
+                        if profiling and stepno >= profile_stop_at:
+                            jax.block_until_ready(self.state)
+                            jax.profiler.stop_trace()
+                            profiling = False
+                            log.info(
+                                "profiler trace written to %s",
+                                cfg.profile_dir,
                             )
-                            trunc_logged = cur_trunc
-                        if metrics_out is not None:
-                            metrics_out.write(json.dumps({
-                                "step": stepno,
-                                "examples": m["examples"],
-                                "loss": m["loss"],
-                                "auc": m["auc"],
-                                "examples_per_sec": rate,
-                                "elapsed": now - t0,
-                            }) + "\n")
-                            metrics_out.flush()
-                    if (
-                        cfg.validation_steps
-                        and cfg.validation_files
-                        and stepno % cfg.validation_steps == 0
-                    ):
-                        vm = self.evaluate(cfg.validation_files)
-                        log.info(
-                            "step %d validation loss %.6f auc %.4f",
-                            stepno, vm["loss"], vm["auc"],
-                        )
-                        if metrics_out is not None:
-                            metrics_out.write(json.dumps({
-                                "step": stepno,
-                                "validation_loss": vm["loss"],
-                                "validation_auc": vm["auc"],
-                            }) + "\n")
-                            metrics_out.flush()
-                    if cfg.save_steps and stepno % cfg.save_steps == 0:
-                        self.save(stepno)
+                        if (
+                            cfg.log_steps
+                            and stepno - last_log_step >= cfg.log_steps
+                        ):
+                            last_log_step = stepno
+                            # Examples come from the on-device weight sum —
+                            # the GLOBAL count in multi-host runs (each host
+                            # only sees its local shard).
+                            m = _finalize_metrics(
+                                self.state.metrics, cfg.loss_type
+                            )
+                            now = time.time()
+                            rate = (m["examples"] - last_log_ex) / max(
+                                now - last_log_t, 1e-9
+                            )
+                            last_log_t, last_log_ex = now, m["examples"]
+                            log.info(
+                                "step %d examples %d loss %.6f auc %.4f "
+                                "ex/s %.0f",
+                                stepno, int(m["examples"]), m["loss"],
+                                m["auc"], rate,
+                            )
+                            # Surface parser truncation (reference FmParser
+                            # warned; silently vanishing features hide data
+                            # bugs like a too-small max_features).
+                            cur_trunc = (
+                                trunc_base + pipeline.truncated_features
+                            )
+                            if cur_trunc > trunc_logged:
+                                log.warning(
+                                    "%d feature occurrences dropped by "
+                                    "max_features=%d since last report "
+                                    "(total %d)",
+                                    cur_trunc - trunc_logged,
+                                    cfg.max_features, cur_trunc,
+                                )
+                                trunc_logged = cur_trunc
+                            if metrics_out is not None:
+                                metrics_out.write(json.dumps({
+                                    "step": stepno,
+                                    "examples": m["examples"],
+                                    "loss": m["loss"],
+                                    "auc": m["auc"],
+                                    "examples_per_sec": rate,
+                                    "elapsed": now - t0,
+                                }) + "\n")
+                                metrics_out.flush()
+                        if (
+                            cfg.validation_steps
+                            and cfg.validation_files
+                            and stepno - last_val_step >= cfg.validation_steps
+                        ):
+                            last_val_step = stepno
+                            vm = self.evaluate(cfg.validation_files)
+                            log.info(
+                                "step %d validation loss %.6f auc %.4f",
+                                stepno, vm["loss"], vm["auc"],
+                            )
+                            if metrics_out is not None:
+                                metrics_out.write(json.dumps({
+                                    "step": stepno,
+                                    "validation_loss": vm["loss"],
+                                    "validation_auc": vm["auc"],
+                                }) + "\n")
+                                metrics_out.flush()
+                        if (
+                            cfg.save_steps
+                            and stepno - last_save_step >= cfg.save_steps
+                        ):
+                            last_save_step = stepno
+                            self.save(stepno)
+                finally:
+                    prefetcher.close()
                 trunc_base += pipeline.truncated_features
             self._epoch = cfg.epoch_num
             self._batches_done = 0

@@ -71,7 +71,10 @@ def exchange_mode(cfg: FmConfig, mesh, n_local_occ: int) -> str:
     grow with vocab, independent of the batch.  "entries" all-gathers
     the deduped touched-row streams — bytes grow with the batch,
     independent of vocab (the reference PS design's IndexedSlices
-    scaling, SURVEY.md §3.2).  "auto" picks whichever moves fewer bytes.
+    scaling, SURVEY.md §3.2).  "auto" picks whichever moves fewer ring
+    words per device, weighing the dense all-reduce at 2x its buffer
+    (reduce-scatter + all-gather phases — see
+    sparse_apply.resolve_exchange).
     """
     return sparse_apply.resolve_exchange(
         cfg.sparse_exchange,
@@ -284,7 +287,9 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
         (P(MODEL_AXIS, None), P(DATA_AXIS), P())
         + (P(MODEL_AXIS, None),) * n_opt
     )
-    outs = jax.shard_map(
+    from fast_tffm_tpu.platform import shard_map
+
+    outs = shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(

@@ -108,10 +108,10 @@ def test_entries_comms_independent_of_vocab():
 
 def test_auto_exchange_picks_by_bytes():
     """auto == dense at small vocab / large batch, entries at large
-    vocab / small batch — whichever the byte model favors."""
+    vocab / small batch — whichever the ring-traffic model favors."""
     mesh = _mesh((2, 4))
     small = FmConfig(
-        vocabulary_size=1 << 12, factor_num=8, max_features=8,
+        vocabulary_size=1 << 10, factor_num=8, max_features=8,
         batch_size=64, lookup="shardmap",
     )
     big = FmConfig(
@@ -125,6 +125,27 @@ def test_auto_exchange_picks_by_bytes():
                          "train_files": [], "weight_files": [],
                          "validation_files": [], "predict_files": []})
     assert shardmap_step.exchange_mode(forced, mesh, n_occ) == "entries"
+
+
+def test_auto_exchange_allreduce_weighting():
+    """Pin the corrected crossover (ADVICE r5): a ring all-reduce moves
+    ~2x its buffer per device, so the dense side weighs double.  Shapes
+    in the band between V*2D and 2*V*2D (where the old, unweighted
+    comparison picked 'dense') must now resolve to 'entries'.
+
+    S=2, vocab_local=1024, d=9, 512-entry cap:
+      entries ring words (per (S-1)): S*cap*(2d+1)  = 2*512*19 = 19456
+      old dense words:                V*2d          = 1024*18  = 18432
+      corrected dense words:          2*V*2d        = 36864
+    """
+    assert sparse_apply.resolve_exchange(
+        "auto", n_local_occ=512, vocab_local=1024, d=9, data_shards=2,
+    ) == "entries"
+    # Just past the corrected crossover (entries words > 2*V*2D) the pick
+    # flips back to dense: same cap against a quarter of the vocab.
+    assert sparse_apply.resolve_exchange(
+        "auto", n_local_occ=512, vocab_local=256, d=9, data_shards=2,
+    ) == "dense"
 
 
 def test_entries_cap_is_batch_bounded():

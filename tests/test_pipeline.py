@@ -283,3 +283,123 @@ def test_pipeline_shard_with_skip(tmp_path):
     s0_skip = _keys(BatchPipeline([str(path)], cfg, epochs=1, shuffle=True,
                                   shard=(0, 2), skip_batches=2))
     assert s0_skip == s0[2:]
+
+
+def test_sort_meta_out_of_range_warns_per_batch(tmp_path, caplog):
+    """An out-of-range-id sort_meta rejection is a data/vocabulary_size
+    integrity bug, not a transient native failure: the pipeline must keep
+    the spec and keep warning on EVERY bad batch instead of going quiet
+    while the device path silently drops those updates (ADVICE r5)."""
+    import logging
+
+    pytest.importorskip("ctypes")
+    from fast_tffm_tpu.data import native
+    from fast_tffm_tpu.ops import sparse_apply
+
+    try:
+        native.sort_meta(np.zeros(4, np.int32), sparse_apply.TILE,
+                         sparse_apply.CHUNK, sparse_apply.TILE)
+    except native.OutOfRangeIdsError:  # pragma: no cover - impossible here
+        pass
+    except Exception:  # pragma: no cover - env-dependent
+        pytest.skip("native lib unavailable")
+
+    # Spec vocab SMALLER than the parser's modulus: the last two of four
+    # batches hold ids out of the spec's [0, TILE) range — the shape of a
+    # config/data mismatch.
+    tile = sparse_apply.TILE
+    path = tmp_path / "oor.libsvm"
+    path.write_text("".join(
+        f"1 {i}:1.0\n" for i in list(range(8)) + [tile + 5] * 8
+    ))
+    cfg = _cfg(thread_num=1, vocabulary_size=4 * tile)
+    spec = (tile, sparse_apply.CHUNK, tile)
+    pipe = BatchPipeline(
+        [str(path)], cfg, epochs=1, shuffle=False, ordered=True,
+        sort_meta_spec=spec,
+    )
+    with caplog.at_level(logging.WARNING):
+        batches = list(pipe)
+    assert len(batches) == 4  # batches still train (device-sort path)
+    bad = [b for b in batches if b.ids.max() >= tile]
+    good = [b for b in batches if b.ids.max() < tile]
+    assert len(bad) == 2 and len(good) == 2
+    assert all(b.sort_meta is None for b in bad)
+    # The spec survives the bad batches: good ones still get host prep.
+    assert all(b.sort_meta is not None for b in good)
+    assert pipe._sort_meta_spec is not None
+    msgs = [r.message for r in caplog.records
+            if "vocabulary_size is wrong" in r.message]
+    assert len(msgs) == len(bad)  # one warning PER bad batch
+
+
+def test_sort_meta_transient_failure_disables_once(data_files, caplog,
+                                                   monkeypatch):
+    """Any OTHER native failure degrades to device sort with ONE warning
+    and disables the spec for the rest of the run."""
+    import logging
+
+    from fast_tffm_tpu.data import native
+    from fast_tffm_tpu.ops import sparse_apply
+
+    def boom(*a, **kw):
+        raise OSError("native lib vanished")
+
+    monkeypatch.setattr(native, "sort_meta", boom)
+    cfg = _cfg(thread_num=1)
+    spec = (cfg.vocabulary_size, sparse_apply.CHUNK, sparse_apply.TILE)
+    pipe = BatchPipeline(
+        data_files, cfg, epochs=1, shuffle=False, ordered=True,
+        sort_meta_spec=spec,
+    )
+    with caplog.at_level(logging.WARNING):
+        batches = list(pipe)
+    assert len(batches) == 4
+    msgs = [r.message for r in caplog.records
+            if "falling back to device sort" in r.message]
+    assert len(msgs) == 1
+    assert pipe._sort_meta_spec is None
+
+
+def test_cache_epochs_replays_same_batches_permuted(data_files):
+    """cache_epochs: epoch 0 parses, later epochs replay the SAME batches
+    (bitwise) in a seeded per-epoch permutation — no re-parse, identical
+    coverage."""
+    cfg = _cfg(thread_num=1)
+    key = lambda b: (b.labels.tobytes(), b.ids.tobytes(), b.vals.tobytes())
+    plain = [key(b) for b in BatchPipeline(
+        data_files, cfg, epochs=1, shuffle=True, ordered=True)]
+    cached = [key(b) for b in BatchPipeline(
+        data_files, cfg, epochs=3, shuffle=True, ordered=True,
+        cache_epochs=True)]
+    assert len(cached) == 3 * len(plain)
+    assert cached[:len(plain)] == plain  # epoch 0 is the normal stream
+    for e in (1, 2):
+        ep = cached[e * len(plain):(e + 1) * len(plain)]
+        assert sorted(ep) == sorted(plain)  # same batches...
+    assert cached[len(plain):2 * len(plain)] != \
+        cached[2 * len(plain):]  # ...different order per epoch
+
+
+def test_cache_epochs_budget_falls_back_to_reparse(data_files):
+    """Blowing the byte budget abandons the cache and re-parses later
+    epochs — every epoch still delivers the full stream."""
+    cfg = _cfg(thread_num=1)
+    got = list(BatchPipeline(
+        data_files, cfg, epochs=2, shuffle=True, ordered=True,
+        cache_epochs=True, cache_max_bytes=1,
+    ))
+    n = sum(int(np.sum(b.weights > 0)) for b in got)
+    assert n == 2 * 15  # both epochs complete
+
+
+def test_cache_epochs_ignored_for_single_epoch_and_sharded(data_files):
+    cfg = _cfg()
+    p1 = BatchPipeline(data_files, cfg, epochs=1, cache_epochs=True)
+    assert not p1._cache_epochs
+    p2 = BatchPipeline(data_files, cfg, epochs=2, cache_epochs=True,
+                       shard=(0, 2))
+    assert not p2._cache_epochs
+    p3 = BatchPipeline(data_files, cfg, epochs=2, cache_epochs=True,
+                       skip_batches=1)
+    assert not p3._cache_epochs

@@ -189,3 +189,45 @@ def test_periodic_validation(tmp_path, rng):
         assert np.isfinite(r["validation_loss"])
         assert 0.0 <= r["validation_auc"] <= 1.0
     assert "validation" in result  # final validation still runs
+
+
+def test_ftrl_warm_start_normalizes_broken_invariant(tmp_path, rng, caplog):
+    """The compact-K2 FTRL apply relies on w == ftrl_solve(z, n) for
+    untouched rows (ops.sparse_apply.ftrl_apply's contract).  A warm
+    start whose table was edited outside train.sparse must fail LOUDLY
+    and be normalized at restore, not drift sweep-dependently (ADVICE
+    r5)."""
+    import logging
+
+    import jax.numpy as jnp
+
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, optimizer="ftrl", learning_rate=0.05)
+    t = Trainer(cfg)
+    t.train()
+    clean_table = np.asarray(t.state.params.table)
+
+    # Violate the invariant the way an external edit would: perturb w,
+    # leave (z, n) alone, re-save.
+    t.state = t.state._replace(
+        params=t.state.params._replace(table=t.state.params.table + 0.5)
+    )
+    t.save(8)
+
+    with caplog.at_level(logging.WARNING):
+        t2 = Trainer(cfg)
+    assert any("ftrl_solve" in r.message for r in caplog.records)
+    # Normalization recovers w = ftrl_solve(z, n) — the pre-edit table.
+    np.testing.assert_allclose(
+        np.asarray(t2.state.params.table), clean_table, rtol=0, atol=1e-6
+    )
+
+    # An invariant-respecting checkpoint restores bit-identically, no
+    # warning: train one more run and warm-start from it untouched.
+    caplog.clear()
+    t2.train()
+    good = np.asarray(t2.state.params.table)
+    with caplog.at_level(logging.WARNING):
+        t3 = Trainer(cfg)
+    assert not any("ftrl_solve" in r.message for r in caplog.records)
+    np.testing.assert_array_equal(np.asarray(t3.state.params.table), good)
