@@ -56,6 +56,23 @@ def build_argparser() -> argparse.ArgumentParser:
         "--prefetch_super_batches", type=int, default=None,
         help="stacked super-batches the transfer stage keeps in flight",
     )
+    # Ingest knobs (override the cfg file).
+    p.add_argument(
+        "--parse_processes", type=int, default=None,
+        help="parse in this many spawned worker processes (GIL-free) "
+             "instead of thread_num in-process threads (0 = threads)",
+    )
+    p.add_argument(
+        "--cache_epochs", action="store_true", default=None,
+        help="parse epoch 0 once and replay later epochs from a host-"
+             "memory batch cache (multi-epoch runs whose parsed data "
+             "fits in cache_max_bytes)",
+    )
+    p.add_argument(
+        "--cache_max_bytes", type=int, default=None,
+        help="byte budget for the epoch cache; overflowing falls back "
+             "to re-parsing later epochs",
+    )
     # Legacy reference flags (mapped, SURVEY.md §3.2).
     p.add_argument("--ps_hosts", default=None, help="legacy; ps tasks exit")
     p.add_argument("--worker_hosts", default=None,
@@ -100,7 +117,8 @@ def main(argv=None) -> int:
 
     overrides = {
         key: getattr(args, key)
-        for key in ("steps_per_dispatch", "prefetch_super_batches")
+        for key in ("steps_per_dispatch", "prefetch_super_batches",
+                    "parse_processes", "cache_epochs", "cache_max_bytes")
         if getattr(args, key) is not None
     }
     cfg = load_config(args.cfg, overrides or None)

@@ -77,6 +77,20 @@ class FmConfig:
     # Input-pipeline knobs (reference queue knobs, SURVEY.md §2 #6).
     thread_num: int = 4
     queue_size: int = 64
+    # Parse in this many spawned worker PROCESSES instead of thread_num
+    # in-process threads (0 = threads).  Escapes the GIL entirely —
+    # required for the pure-Python parse fallback to scale at all, and
+    # frees the trainer process's interpreter on the native path too.
+    # Parsed batches return over POSIX shared memory (data.procpool).
+    parse_processes: int = 0
+    # Multi-epoch parsed-batch cache (the tf.data .cache() pattern):
+    # epoch 0 parses, epochs 1..E-1 replay the cached batches in a
+    # seeded per-epoch permutation — no re-read/re-parse.  Cross-epoch
+    # remixing drops to batch granularity (the documented tradeoff).
+    # cache_max_bytes bounds host memory; overflowing it falls back to
+    # re-parsing later epochs (cache_result = "overflow").
+    cache_epochs: bool = False
+    cache_max_bytes: int = 1 << 30
     # Kept for config compatibility: the reference ran N shuffle-queue
     # threads between its reader and parser queues.  Here shuffling is a
     # window permutation inside the (single, sequential-IO) reader thread
@@ -204,6 +218,14 @@ class FmConfig:
                 "prefetch_super_batches must be >= 1, got "
                 f"{self.prefetch_super_batches}"
             )
+        if self.parse_processes < 0:
+            raise ValueError(
+                f"parse_processes must be >= 0, got {self.parse_processes}"
+            )
+        if self.cache_max_bytes <= 0:
+            raise ValueError(
+                f"cache_max_bytes must be positive, got {self.cache_max_bytes}"
+            )
         if self.weight_files and len(self.weight_files) != len(self.train_files):
             raise ValueError(
                 "weight_files must parallel train_files "
@@ -291,6 +313,9 @@ _KEYMAP = {
     "sparse_exchange": ("sparse_exchange", str),
     "steps_per_dispatch": ("steps_per_dispatch", int),
     "prefetch_super_batches": ("prefetch_super_batches", int),
+    "parse_processes": ("parse_processes", int),
+    "cache_epochs": ("cache_epochs", _parse_bool),
+    "cache_max_bytes": ("cache_max_bytes", int),
 }
 
 

@@ -9,16 +9,24 @@ in place of TF queues.
 
 Parsing uses the C++ extension when available (multi-threaded tokenizer +
 murmur hashing, like the reference's ``FmParser``) and falls back to the
-pure-Python oracle.
+pure-Python oracle.  ``parse_processes`` moves parsing into a spawned
+worker-process pool (``data.procpool``) that ships parsed batches back over
+POSIX shared memory — the GIL-free analogue of the reference's free-running
+C++ parser threads, and the only way the pure-Python parse path scales.
+
+One pipeline spans ALL epochs of a run (``epochs``/``start_epoch``): the
+reader reseeds per epoch, emits :class:`EpochEnd` markers in-band
+(``epoch_marks=True``), and — with ``cache_epochs`` — retains epoch 0's
+parsed batches so later epochs replay from memory instead of re-parsing.
 """
 
 from __future__ import annotations
 
 import logging
-import queue
 import random
 import threading
-from typing import Iterator, Optional, Sequence
+from collections import deque
+from typing import Iterator, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -34,6 +42,20 @@ log = logging.getLogger(__name__)
 _CHUNK_BYTES = 4 << 20
 
 _SENTINEL = object()
+_CANCELLED = object()
+
+
+class EpochEnd(NamedTuple):
+    """In-band epoch-boundary marker (``epoch_marks=True``).
+
+    Yielded by BatchPipeline after the last batch of ``epoch``; the
+    DevicePrefetcher flushes its pending super-batch group at a marker
+    and forwards it, so super-batches never span epochs and the trainer
+    can advance its checkpointed (epoch, batches_done) position without
+    owning the epoch loop.
+    """
+
+    epoch: int
 
 
 class _Error:
@@ -41,6 +63,49 @@ class _Error:
 
     def __init__(self, exc: BaseException):
         self.exc = exc
+
+
+class _ClosableQueue:
+    """Bounded queue whose ``cancel()`` wakes every blocked producer and
+    consumer immediately — deterministic shutdown with no timed polling
+    (the previous design's 0.1 s put/get polls could leave workers
+    lingering a poll period after close).
+
+    ``put`` returns False (instead of blocking) once cancelled; ``get``
+    returns the module-level ``_CANCELLED`` sentinel.
+    """
+
+    def __init__(self, maxsize: int):
+        self._items: deque = deque()
+        self._max = max(1, maxsize)
+        self._cv = threading.Condition()
+        self._cancelled = False
+
+    def put(self, item) -> bool:
+        with self._cv:
+            while len(self._items) >= self._max and not self._cancelled:
+                self._cv.wait()
+            if self._cancelled:
+                return False
+            self._items.append(item)
+            self._cv.notify_all()
+            return True
+
+    def get(self):
+        with self._cv:
+            while not self._items and not self._cancelled:
+                self._cv.wait()
+            if not self._items:
+                return _CANCELLED
+            item = self._items.popleft()
+            self._cv.notify_all()
+            return item
+
+    def cancel(self):
+        with self._cv:
+            self._cancelled = True
+            self._items.clear()
+            self._cv.notify_all()
 
 
 def _read_weight_file(path: str) -> list[str]:
@@ -211,6 +276,14 @@ def _item_len(item) -> int:
     return len(item)
 
 
+def _batch_nbytes(batch: libsvm.Batch) -> int:
+    arrays = [batch.labels, batch.ids, batch.vals, batch.fields,
+              batch.weights]
+    if batch.sort_meta is not None:
+        arrays.extend(batch.sort_meta)  # ~doubles a batch
+    return sum(a.nbytes for a in arrays)
+
+
 def _strided_rounds(it, shard_id: int, num_shards: int):
     """Yield every num_shards-th item, but only from COMPLETE rounds.
 
@@ -236,14 +309,24 @@ def _strided_rounds(it, shard_id: int, num_shards: int):
 
 
 class BatchPipeline:
-    """Background-threaded parse/batch pipeline.
+    """Background parse/batch pipeline spanning a whole training run.
 
     One reader thread streams work items into a queue; ``thread_num``
-    parser threads turn them into padded :class:`Batch` objects pushed to
-    a bounded output queue (``queue_size``).  Batch order is
-    nondeterministic across parser threads (like the reference's async
-    queues) unless ``ordered=True``, which keeps the parallel parse but
-    reorders delivery by sequence number (deterministic given the seed).
+    parser threads (or, with ``parse_processes > 0``, that many spawned
+    worker PROCESSES — see :mod:`fast_tffm_tpu.data.procpool`) turn them
+    into padded :class:`Batch` objects pushed to a bounded output queue
+    (``queue_size``).  Batch order is nondeterministic across parser
+    workers (like the reference's async queues) unless ``ordered=True``,
+    which keeps the parallel parse but reorders delivery by sequence
+    number (deterministic given the seed).
+
+    The pipeline owns the EPOCH loop: ``epochs`` is the run's total epoch
+    count, epoch e reseeds with ``seed + e``, and ``start_epoch`` /
+    ``skip_batches`` name a resume position ("skip to (epoch, batch)").
+    With ``epoch_marks=True`` an :class:`EpochEnd` marker is yielded
+    in-band after each epoch's last batch (exact under ``ordered=True``;
+    with free-running workers it can arrive up to the in-flight batch
+    count early).
     """
 
     def __init__(
@@ -257,11 +340,13 @@ class BatchPipeline:
         drop_remainder: bool = False,
         seed: Optional[int] = None,
         ordered: bool = False,
+        start_epoch: int = 0,
         skip_batches: int = 0,
         shard: tuple[int, int] = (0, 1),
         sort_meta_spec=None,
         cache_epochs: bool = False,
         cache_max_bytes: int = 1 << 30,
+        epoch_marks: bool = False,
     ):
         self.files = list(files)
         self.cfg = cfg
@@ -270,11 +355,16 @@ class BatchPipeline:
         self.shuffle = shuffle
         self.drop_remainder = drop_remainder
         self.seed = cfg.seed if seed is None else seed
-        # Mid-epoch resume: skip the first N batches of epoch 0 WITHOUT
-        # parsing them.  Skipping happens after shuffling, so the stream
-        # continues exactly where a run with the same seed left off (batch
-        # delivery order across >1 parser threads remains nondeterministic,
-        # like the reference's async queues).
+        # Resume position: deliver epochs [start_epoch, epochs), skipping
+        # the first skip_batches of epoch start_epoch WITHOUT parsing them
+        # (the cached path re-parses epoch 0 to rebuild the replay cache —
+        # see __iter__).  Skipping happens after shuffling, so the stream
+        # continues exactly where a run with the same seed left off.
+        if not 0 <= start_epoch < max(1, epochs):
+            raise ValueError(
+                f"start_epoch {start_epoch} outside [0, {epochs})"
+            )
+        self.start_epoch = start_epoch
         self.skip_batches = skip_batches
         # Multi-host input sharding (shard_id, num_shards): this pipeline
         # emits only its strided share of the global stream, round-complete
@@ -287,6 +377,7 @@ class BatchPipeline:
         # identical order).  Parsing still runs on thread_num workers —
         # items carry sequence numbers and the consumer reorders.
         self.ordered = ordered
+        self.epoch_marks = epoch_marks
         self._native, self._parser = _make_parser(cfg)
         # (vocab, chunk, tile) or None: when set, workers attach host-
         # computed sparse-apply prep (native.sort_meta) to each batch,
@@ -297,6 +388,12 @@ class BatchPipeline:
             sort_meta_spec if self._native is not None else None
         )
         self._sort_meta_warned = False
+        # Truncation counted OUTSIDE the in-process native parser: process
+        # workers ship their per-batch drop counts back with each batch,
+        # and cached-epoch replays re-add epoch 0's total per replay (the
+        # same features a re-parse would have dropped again), so the
+        # trainer's periodic warning stays truthful in every ingest mode.
+        self._trunc_extra = 0
         # Fast ingest: raw binary chunks + C++ line scan, no Python string
         # per line. Requires the native parser; weight_files need per-line
         # pairing so they stay on the line path. Shuffling permutes LINES
@@ -313,166 +410,260 @@ class BatchPipeline:
         # re-parsing the same text.  Batch contents are preserved exactly
         # (so attached sort_meta stays valid); cross-epoch remixing drops
         # to batch granularity — the documented tradeoff, opt-in only.
-        # Engages only in the simple streaming case; a byte budget guards
-        # host memory (overflow falls back to re-parsing).
+        # A byte budget guards host memory (overflow falls back to
+        # re-parsing); resume positions are honored (cache-aware: epoch 0
+        # re-parses once to rebuild the cache, later epochs replay).
         self._cache_epochs = (
-            cache_epochs and epochs > 1 and skip_batches == 0
-            and shard == (0, 1)
+            cache_epochs and epochs > 1 and shard == (0, 1)
         )
         self._cache_max_bytes = cache_max_bytes
         # Outcome of the cache for observability: "off" | "cached" |
-        # "overflow" (budget blown mid-epoch-0; later epochs re-parsed).
+        # "overflow" (budget blown during epoch 0; later epochs re-parsed).
         self.cache_result = "off"
 
     @property
     def truncated_features(self) -> int:
         """Feature occurrences dropped by max_features so far (reference
         FmParser warned about truncation, SURVEY.md §2 #1); the trainer
-        surfaces this periodically."""
-        return self._native.truncated_features if self._native else 0
+        surfaces this periodically.  Includes process-worker drops and
+        cached-epoch replays (each replay re-adds epoch 0's total)."""
+        base = self._native.truncated_features if self._native else 0
+        return base + self._trunc_extra
 
-    def __iter__(self) -> Iterator[libsvm.Batch]:
+    def __iter__(self) -> Iterator:
+        E, e0 = self.epochs, self.start_epoch
         if not self._cache_epochs:
-            yield from self._iter_stream(self.epochs)
+            yield from self._emit_stream(E - e0, e0, self.skip_batches)
             return
+        yield from self._iter_cached(E, e0)
+
+    def _emit_stream(self, n_epochs: int, first_epoch: int, skip: int):
+        """_iter_stream with EpochEnd markers filtered per epoch_marks."""
+        for item in self._iter_stream(n_epochs, first_epoch, skip):
+            if isinstance(item, EpochEnd) and not self.epoch_marks:
+                continue
+            yield item
+
+    def _iter_cached(self, E: int, e0: int):
+        """cache_epochs delivery: parse epoch 0 once (caching every
+        batch), then replay epochs 1..E-1 as seeded permutations of the
+        cache.  A resume past the start of epoch 0 re-parses epoch 0 to
+        REBUILD the cache (delivering nothing for already-trained
+        batches), then replays from the resume position — later epochs
+        come from memory instead of a per-epoch re-parse."""
         cache: Optional[list] = []
         size = 0
         self.cache_result = "cached"
-        for batch in self._iter_stream(1):
-            if cache is not None:
-                arrays = [batch.labels, batch.ids, batch.vals,
-                          batch.fields, batch.weights]
-                if batch.sort_meta is not None:
-                    arrays.extend(batch.sort_meta)  # ~doubles a batch
-                size += sum(a.nbytes for a in arrays)
-                if size > self._cache_max_bytes:
-                    log.info(
-                        "ingest cache over budget (%d > %d bytes); "
-                        "re-parsing later epochs", size,
-                        self._cache_max_bytes,
-                    )
-                    cache = None
-                    self.cache_result = "overflow"
-                else:
-                    cache.append(batch)
-            yield batch
+        deliver = e0 == 0
+        skip = self.skip_batches
+        trunc_start = self.truncated_features
+        n_seen = 0
+        stream = self._iter_stream(1, 0, 0)
+        try:
+            for item in stream:
+                if isinstance(item, EpochEnd):
+                    if deliver and self.epoch_marks:
+                        yield item
+                    continue
+                if cache is not None:
+                    size += _batch_nbytes(item)
+                    if size > self._cache_max_bytes:
+                        log.info(
+                            "ingest cache over budget (%d > %d bytes); "
+                            "re-parsing later epochs", size,
+                            self._cache_max_bytes,
+                        )
+                        cache = None
+                        self.cache_result = "overflow"
+                        if not deliver:
+                            break  # rebuild-only parse: stop early
+                    else:
+                        cache.append(item)
+                n_seen += 1
+                if deliver and n_seen > skip:
+                    yield item
+        finally:
+            stream.close()
         if cache is None:  # budget blown: stream the remaining epochs
-            yield from self._iter_stream(self.epochs - 1, first_epoch=1)
+            if deliver:
+                if E > 1:
+                    yield from self._emit_stream(E - 1, 1, 0)
+            else:
+                # The resumed epoch streams from ITS seed with the skip —
+                # identical to what the uninterrupted overflow run
+                # delivered for that epoch.
+                yield from self._emit_stream(E - e0, e0, skip)
             return
-        for epoch in range(1, self.epochs):
+        epoch0_trunc = self.truncated_features - trunc_start
+        for epoch in range(max(1, e0), E):
             order = list(range(len(cache)))
             if self.shuffle:
                 random.Random(self.seed + epoch).shuffle(order)
-            for i in order:
+            start = skip if epoch == e0 else 0
+            for i in order[start:]:
                 yield cache[i]
+            # A re-parse of this epoch would have dropped the same
+            # features again; keep the running counter truthful.
+            self._trunc_extra += epoch0_trunc
+            if self.epoch_marks:
+                yield EpochEnd(epoch)
+
+    # ------------------------------------------------------------------
+    # Streaming core: reader -> parse workers (threads or processes)
+    # ------------------------------------------------------------------
+
+    def _line_chunks(self, rng):
+        """Line path: line-level shuffle, then fixed-size chunks."""
+        cfg = self.cfg
+        it = iter_lines(self.files, self.weight_files)
+        if self.shuffle:
+            it = _shuffled(it, max(1, cfg.shuffle_buffer), rng)
+        chunk: list[tuple[str, float]] = []
+        for item in it:
+            chunk.append(item)
+            if len(chunk) == cfg.batch_size:
+                yield chunk
+                chunk = []
+        if chunk:
+            yield chunk
+
+    def _raw_groups(self, rng):
+        """Fast path: scan-once windows -> line-level shuffle ->
+        groups.  The shuffle window is ``shuffle_buffer`` LINES (like
+        the line path's reservoir), permuted with numpy — each group
+        then references a shuffled, non-contiguous view of the window
+        buffer, which parse_raw gathers zero-copy."""
+        cfg = self.cfg
+        window = (
+            max(cfg.shuffle_buffer, cfg.batch_size)
+            if self.shuffle else cfg.batch_size
+        )
+        for buf, starts, ends in _iter_raw_windows(
+            self.files, cfg.batch_size, window
+        ):
+            n = len(starts)
+            if self.shuffle and n > 1:
+                perm = np.random.default_rng(
+                    rng.getrandbits(63)
+                ).permutation(n)
+                starts, ends = starts[perm], ends[perm]
+            for i in range(0, n, cfg.batch_size):
+                yield buf, starts[i:i + cfg.batch_size], ends[
+                    i:i + cfg.batch_size
+                ]
+
+    def _epoch_items(self, n_epochs: int, first_epoch: int, skip: int):
+        """(seq, work-item-or-EpochEnd) across epochs — the reader-side
+        epoch loop: per-epoch reseeding (``seed + epoch``, matching what
+        a fresh per-epoch pipeline would draw), drop_remainder filtering
+        BEFORE sharding (all shards must see the same global item
+        indexing), strided multi-host sharding, and the resume skip
+        (first epoch only, post-shard)."""
+        cfg = self.cfg
+        seq = 0
+        for epoch in range(first_epoch, first_epoch + n_epochs):
+            rng = random.Random(self.seed + epoch)
+            to_skip = skip if epoch == first_epoch else 0
+            if self._raw:
+                # Line-level shuffle happens inside _raw_groups over
+                # shuffle_buffer-line windows — the same mixing window as
+                # the line path's reservoir, so no group-order reservoir
+                # on top (stacking one would pin many window buffers).
+                it = self._raw_groups(rng)
+            else:
+                it = self._line_chunks(rng)
+            if self.drop_remainder:
+                it = (x for x in it if _item_len(x) >= cfg.batch_size)
+            if self.shard[1] > 1:
+                it = _strided_rounds(it, *self.shard)
+            for item in it:
+                if to_skip > 0:
+                    to_skip -= 1
+                    continue
+                yield seq, item
+                seq += 1
+            yield seq, EpochEnd(epoch)
+            seq += 1
 
     def _iter_stream(
-        self, n_epochs: int, first_epoch: int = 0
-    ) -> Iterator[libsvm.Batch]:
-        cfg = self.cfg
-        work: queue.Queue = queue.Queue(maxsize=max(2, cfg.queue_size))
-        out: queue.Queue = queue.Queue(maxsize=max(2, cfg.queue_size))
-        n_workers = max(1, cfg.thread_num)
-        stop = threading.Event()
+        self, n_epochs: int, first_epoch: int = 0, skip: int = 0
+    ) -> Iterator:
+        if n_epochs <= 0:
+            return
+        if self.cfg.parse_processes > 0:
+            yield from self._iter_stream_procs(n_epochs, first_epoch, skip)
+        else:
+            yield from self._iter_stream_threads(n_epochs, first_epoch, skip)
 
-        def put_checked(q: queue.Queue, item) -> bool:
-            """Bounded put that gives up once the consumer is gone."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+    def _attach_meta(self, batch: libsvm.Batch) -> libsvm.Batch:
+        """Host sort prep for one batch (thread-mode workers)."""
+        from fast_tffm_tpu.data import native as _native
 
-        def _line_chunks(rng):
-            """Line path: line-level shuffle, then fixed-size chunks."""
-            it = iter_lines(self.files, self.weight_files)
-            if self.shuffle:
-                it = _shuffled(it, max(1, cfg.shuffle_buffer), rng)
-            chunk: list[tuple[str, float]] = []
-            for item in it:
-                chunk.append(item)
-                if len(chunk) == cfg.batch_size:
-                    yield chunk
-                    chunk = []
-            if chunk:
-                yield chunk
-
-        def _raw_groups(rng):
-            """Fast path: scan-once windows -> line-level shuffle ->
-            groups.  The shuffle window is ``shuffle_buffer`` LINES (like
-            the line path's reservoir), permuted with numpy — each group
-            then references a shuffled, non-contiguous view of the window
-            buffer, which parse_raw gathers zero-copy."""
-            window = (
-                max(cfg.shuffle_buffer, cfg.batch_size)
-                if self.shuffle else cfg.batch_size
+        # Metadata is an optimization, not a correctness requirement:
+        # the device-sort path handles sort_meta=None.  A native failure
+        # here must degrade, not kill the epoch — same contract as
+        # Trainer._put's fallback.  But the two failure classes degrade
+        # differently (ADVICE r5): out-of-range ids are a
+        # data/vocabulary_size integrity bug whose updates the device
+        # path SILENTLY drops, so that warning repeats per bad batch;
+        # any other native failure disables the spec once and goes quiet.
+        try:
+            return batch._replace(
+                sort_meta=_native.sort_meta(
+                    batch.ids, *self._sort_meta_spec
+                )
             )
-            for buf, starts, ends in _iter_raw_windows(
-                self.files, cfg.batch_size, window
-            ):
-                n = len(starts)
-                if self.shuffle and n > 1:
-                    perm = np.random.default_rng(
-                        rng.getrandbits(63)
-                    ).permutation(n)
-                    starts, ends = starts[perm], ends[perm]
-                for i in range(0, n, cfg.batch_size):
-                    yield buf, starts[i:i + cfg.batch_size], ends[
-                        i:i + cfg.batch_size
-                    ]
+        except _native.OutOfRangeIdsError as e:
+            log.warning(
+                "host sort_meta rejected a batch (%s); the input data or "
+                "vocabulary_size is wrong — the device-sort path will "
+                "silently drop updates for ids >= vocabulary_size", e,
+            )
+        except Exception as e:
+            self._sort_meta_spec = None
+            if not self._sort_meta_warned:
+                self._sort_meta_warned = True
+                log.warning(
+                    "host sort_meta failed (%s: %s); falling back to "
+                    "device sort for the rest of the run",
+                    type(e).__name__, e,
+                )
+        return batch
+
+    def _iter_stream_threads(
+        self, n_epochs: int, first_epoch: int, skip: int
+    ) -> Iterator:
+        cfg = self.cfg
+        work = _ClosableQueue(max(2, cfg.queue_size))
+        out = _ClosableQueue(max(2, cfg.queue_size))
+        n_workers = max(1, cfg.thread_num)
 
         def reader():
             try:
-                seq = 0
-                for epoch in range(first_epoch, first_epoch + n_epochs):
-                    rng = random.Random(self.seed + epoch)
-                    to_skip = self.skip_batches if epoch == 0 else 0
-                    if self._raw:
-                        # Line-level shuffle happens inside _raw_groups
-                        # over shuffle_buffer-line windows — the same
-                        # mixing window as the line path's reservoir, so
-                        # no group-order reservoir on top (stacking one
-                        # would pin many window buffers at once).
-                        it = _raw_groups(rng)
-                    else:
-                        it = _line_chunks(rng)
-                    if self.drop_remainder:
-                        # Filter BEFORE sharding so all shards see the same
-                        # global item indexing (a partial group dropped by
-                        # one host only would desync step counts).
-                        it = (
-                            x for x in it
-                            if _item_len(x) >= cfg.batch_size
-                        )
-                    if self.shard[1] > 1:
-                        it = _strided_rounds(it, *self.shard)
-                    for item in it:
-                        if stop.is_set():
-                            return
-                        if to_skip > 0:
-                            to_skip -= 1
-                            continue
-                        if not put_checked(work, (seq, item)):
-                            return
-                        seq += 1
+                for seq, item in self._epoch_items(
+                    n_epochs, first_epoch, skip
+                ):
+                    if not work.put((seq, item)):
+                        return
             except BaseException as e:  # surfaces in the consumer
-                put_checked(out, _Error(e))
+                out.put(_Error(e))
             finally:
                 for _ in range(n_workers):
-                    put_checked(work, _SENTINEL)
+                    if not work.put(_SENTINEL):
+                        break
 
         def parse_worker():
-            while not stop.is_set():
-                try:
-                    got = work.get(timeout=0.1)
-                except queue.Empty:
-                    continue
+            while True:
+                got = work.get()
+                if got is _CANCELLED:
+                    return
                 if got is _SENTINEL:
-                    put_checked(out, _SENTINEL)
+                    out.put(_SENTINEL)
                     return
                 seq, chunk = got
+                if isinstance(chunk, EpochEnd):
+                    out.put((seq, chunk))
+                    continue
                 try:
                     if isinstance(chunk, tuple):  # raw (buf, starts, ends)
                         batch = self._native.parse_raw(
@@ -483,47 +674,11 @@ class BatchPipeline:
                         weights = [c[1] for c in chunk]
                         batch = self._parser(lines, weights)
                     if self._sort_meta_spec is not None:
-                        from fast_tffm_tpu.data import native as _native
-
-                        # Metadata is an optimization, not a correctness
-                        # requirement: the device-sort path handles
-                        # sort_meta=None.  A native failure here must
-                        # degrade, not kill the epoch — same contract as
-                        # Trainer._put's fallback.  But the two failure
-                        # classes degrade differently (ADVICE r5):
-                        # out-of-range ids are a data/vocabulary_size
-                        # integrity bug whose updates the device path
-                        # SILENTLY drops, so that warning repeats per bad
-                        # batch; any other native failure disables the
-                        # spec once and goes quiet.
-                        try:
-                            batch = batch._replace(
-                                sort_meta=_native.sort_meta(
-                                    batch.ids, *self._sort_meta_spec
-                                )
-                            )
-                        except _native.OutOfRangeIdsError as e:
-                            log.warning(
-                                "host sort_meta rejected a batch (%s); "
-                                "the input data or vocabulary_size is "
-                                "wrong — the device-sort path will "
-                                "silently drop updates for ids >= "
-                                "vocabulary_size", e,
-                            )
-                        except Exception as e:
-                            self._sort_meta_spec = None
-                            if not self._sort_meta_warned:
-                                self._sort_meta_warned = True
-                                log.warning(
-                                    "host sort_meta failed (%s: %s); "
-                                    "falling back to device sort for the "
-                                    "rest of the run",
-                                    type(e).__name__, e,
-                                )
+                        batch = self._attach_meta(batch)
                 except BaseException as e:
-                    put_checked(out, _Error(e))
+                    out.put(_Error(e))
                     continue
-                put_checked(out, (seq, batch))
+                out.put((seq, batch))
 
         threads = [threading.Thread(target=reader, daemon=True)]
         threads += [
@@ -538,19 +693,21 @@ class BatchPipeline:
         try:
             while finished < n_workers:
                 item = out.get()
+                if item is _CANCELLED:
+                    return  # torn down externally
                 if item is _SENTINEL:
                     finished += 1
                     continue
                 if isinstance(item, _Error):
                     raise item.exc
-                seq, batch = item
+                seq, obj = item
                 if not self.ordered:
-                    yield batch
+                    yield obj
                     continue
                 # Reorder by sequence number: parsing is parallel but
                 # delivery follows reader order (bounded by in-flight
                 # items: work queue + workers + out queue).
-                held[seq] = batch
+                held[seq] = obj
                 while next_seq in held:
                     yield held.pop(next_seq)
                     next_seq += 1
@@ -559,17 +716,196 @@ class BatchPipeline:
             for seq in sorted(held):
                 yield held[seq]
         finally:
-            # Unblock and reap every thread: stop flag + drain both queues.
-            stop.set()
+            # Deterministic shutdown: cancel wakes every blocked put/get
+            # at once, so joins complete without timed polling.
+            work.cancel()
+            out.cancel()
             for t in threads:
-                while t.is_alive():
-                    for q in (work, out):
-                        try:
-                            while True:
-                                q.get_nowait()
-                        except queue.Empty:
-                            pass
-                    t.join(timeout=0.05)
+                t.join()
+
+    def _iter_stream_procs(
+        self, n_epochs: int, first_epoch: int, skip: int
+    ) -> Iterator:
+        """Multiprocess parse: the reader thread coalesces work by raw
+        window (each window's bytes cross the queue ONCE) and a spawned
+        worker pool parses + preps batches, shipping them back as shared
+        memory segments (data.procpool) — parsing never touches this
+        process's GIL, which is what makes ``thread_num`` useless on the
+        pure-Python parse path."""
+        import multiprocessing as mp
+        import queue as _q
+
+        from fast_tffm_tpu.data import procpool
+
+        cfg = self.cfg
+        ctx = mp.get_context("spawn")
+        n_workers = max(1, cfg.parse_processes)
+        # Raw work items are whole windows (many batches each); a couple
+        # per worker bounds resident window bytes without starving.
+        work = ctx.Queue(maxsize=max(2, min(cfg.queue_size, 2 * n_workers)))
+        out = ctx.Queue(maxsize=max(2, cfg.queue_size))
+        stop = ctx.Event()
+        spec = procpool.WorkerSpec(
+            vocabulary_size=cfg.vocabulary_size,
+            max_features=cfg.max_features,
+            hash_feature_id=cfg.hash_feature_id,
+            field_num=cfg.field_num,
+            batch_size=cfg.batch_size,
+            use_native=self._native is not None,
+            sort_meta_spec=self._sort_meta_spec,
+        )
+        procs = [
+            ctx.Process(
+                target=procpool.parse_worker_main,
+                args=(spec, work, out, stop), daemon=True,
+            )
+            for _ in range(n_workers)
+        ]
+        for p in procs:
+            p.start()
+
+        def put_mp(q, item) -> bool:
+            return procpool.put_with_stop(q, item, stop)
+
+        reader_err: list = []
+
+        def reader():
+            pend = None  # (buf, seq0, [starts...], [ends...])
+
+            def flush() -> bool:
+                nonlocal pend
+                if pend is None:
+                    return True
+                msg = ("raw", pend[1], pend[0], pend[2], pend[3])
+                pend = None
+                return put_mp(work, msg)
+
+            try:
+                for seq, item in self._epoch_items(
+                    n_epochs, first_epoch, skip
+                ):
+                    if isinstance(item, EpochEnd):
+                        if not flush():
+                            return
+                        if not put_mp(work, ("mark", seq, item.epoch)):
+                            return
+                    elif isinstance(item, tuple):  # raw group
+                        buf, s, e = item
+                        if pend is not None and pend[0] is not buf:
+                            if not flush():
+                                return
+                        if pend is None:
+                            pend = (buf, seq, [s], [e])
+                        else:
+                            pend[2].append(s)
+                            pend[3].append(e)
+                    else:  # line chunk
+                        if not flush():
+                            return
+                        lines = [c[0] for c in item]
+                        weights = [c[1] for c in item]
+                        if not put_mp(
+                            work, ("lines", seq, lines, weights)
+                        ):
+                            return
+                if not flush():
+                    return
+            except BaseException as e:
+                reader_err.append(e)
+            finally:
+                for _ in range(n_workers):
+                    if not put_mp(work, None):
+                        break
+
+        rt = threading.Thread(target=reader, daemon=True)
+        rt.start()
+        expect_done = n_workers
+        next_seq = 0
+        held: dict = {}
+        try:
+            while expect_done > 0:
+                if reader_err:
+                    raise reader_err.pop()
+                try:
+                    msg = out.get(timeout=0.1)
+                except _q.Empty:
+                    dead = [p for p in procs
+                            if p.exitcode not in (None, 0)]
+                    if dead:
+                        raise RuntimeError(
+                            f"parse worker died (exitcode "
+                            f"{dead[0].exitcode})"
+                        )
+                    continue
+                kind = msg[0]
+                if kind == "done":
+                    expect_done -= 1
+                    continue
+                if kind == "err":
+                    raise msg[1]
+                if kind == "mark":
+                    seq, obj = msg[1], EpochEnd(msg[2])
+                else:  # ("batch", seq, shm_name, has_meta, trunc, note)
+                    seq = msg[1]
+                    obj = procpool.attach_batch(spec, msg[2], msg[3])
+                    self._trunc_extra += msg[4]
+                    self._log_worker_note(msg[5])
+                if not self.ordered:
+                    yield obj
+                    continue
+                held[seq] = obj
+                while next_seq in held:
+                    yield held.pop(next_seq)
+                    next_seq += 1
+            if reader_err:
+                raise reader_err.pop()
+            for seq in sorted(held):
+                yield held[seq]
+        finally:
+            stop.set()
+            # Reap the pool first (workers give up their blocked puts
+            # within one poll period; their queue feeders flush on
+            # exit), THEN drain: every shipped-but-unconsumed segment is
+            # guaranteed visible by the time the workers are gone, so
+            # none outlives the run in /dev/shm.  A terminated straggler
+            # can still lose in-flight messages — the worker-side emit()
+            # fallback covers its own unsent segment.
+            rt.join()
+            for p in procs:
+                p.join(timeout=5)
+            for p in procs:
+                if p.is_alive():  # pragma: no cover - stuck worker
+                    p.terminate()
+                    p.join(timeout=5)
+            try:
+                while True:
+                    msg = out.get_nowait()
+                    if msg and msg[0] == "batch":
+                        procpool.discard_segment(msg[2])
+            except _q.Empty:
+                pass
+            for q in (work, out):
+                q.close()
+                q.cancel_join_thread()
+
+    def _log_worker_note(self, note) -> None:
+        """Mirror thread-mode sort_meta degradation logging for notes a
+        process worker shipped back with a batch."""
+        if note is None:
+            return
+        kind, msg = note
+        if kind == "oor":
+            log.warning(
+                "host sort_meta rejected a batch (%s); the input data or "
+                "vocabulary_size is wrong — the device-sort path will "
+                "silently drop updates for ids >= vocabulary_size", msg,
+            )
+        elif not self._sort_meta_warned:
+            self._sort_meta_warned = True
+            log.warning(
+                "host sort_meta failed in a parse worker (%s); those "
+                "workers fall back to device sort", msg,
+            )
 
 
 def stack_batches(batches: Sequence[libsvm.Batch]) -> libsvm.Batch:
@@ -622,17 +958,21 @@ class DevicePrefetcher:
     capped at ~(depth + 1) super-batches.  The source's tail yields a
     short super-batch at K' = leftover.
 
-    Iterating yields ``(device_super_batch, n_batches)``.  Exceptions
-    from the source or the transfer re-raise in the consumer; ``close()``
-    stops the thread and is idempotent (iteration calls it on exit).
+    Iterating yields ``(device_super_batch, n_batches)``.  An
+    :class:`EpochEnd` marker from the source flushes the pending group
+    (so super-batches never span epochs — the epoch tail dispatches at
+    K' = leftover, exactly like before) and is forwarded verbatim.
+    Exceptions from the source or the transfer re-raise in the consumer;
+    ``close()`` cancels the output queue (waking a blocked producer
+    immediately — no poll latency) and joins the thread; it is
+    idempotent (iteration calls it on exit).
     """
 
     def __init__(self, source, steps_per_dispatch: int, put_fn,
                  depth: int = 2):
         self._k = max(1, steps_per_dispatch)
         self._put_fn = put_fn
-        self._out: queue.Queue = queue.Queue(maxsize=max(1, depth))
-        self._stop = threading.Event()
+        self._out = _ClosableQueue(max(1, depth))
         self._thread = threading.Thread(
             target=self._run, args=(iter(source),), daemon=True
         )
@@ -641,21 +981,29 @@ class DevicePrefetcher:
     def _run(self, it):
         try:
             group: list = []
-            while not self._stop.is_set():
+            while True:
                 batch = next(it, _SENTINEL)
                 if batch is _SENTINEL:
                     break
+                if isinstance(batch, EpochEnd):
+                    if group:
+                        if not self._emit(group):
+                            return
+                        group = []
+                    if not self._out.put(batch):
+                        return
+                    continue
                 group.append(batch)
                 if len(group) == self._k:
                     if not self._emit(group):
                         return
                     group = []
-            if group and not self._stop.is_set():
+            if group:
                 self._emit(group)  # epoch tail: K' = leftover
         except BaseException as e:  # surfaces in the consumer
-            self._offer(_Error(e))
+            self._out.put(_Error(e))
         finally:
-            self._offer(_SENTINEL)
+            self._out.put(_SENTINEL)
             # Deterministically release the source's own resources (a
             # BatchPipeline generator holds parser threads + queues).
             close = getattr(it, "close", None)
@@ -667,23 +1015,13 @@ class DevicePrefetcher:
 
     def _emit(self, group) -> bool:
         dev = self._put_fn(stack_batches(group))
-        return self._offer((dev, len(group)))
-
-    def _offer(self, item) -> bool:
-        """Bounded put that gives up once the consumer is gone."""
-        while not self._stop.is_set():
-            try:
-                self._out.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+        return self._out.put((dev, len(group)))
 
     def __iter__(self):
         try:
             while True:
                 item = self._out.get()
-                if item is _SENTINEL:
+                if item is _SENTINEL or item is _CANCELLED:
                     return
                 if isinstance(item, _Error):
                     raise item.exc
@@ -693,14 +1031,8 @@ class DevicePrefetcher:
 
     def close(self):
         """Stop the transfer thread and reap it (idempotent)."""
-        self._stop.set()
-        while self._thread.is_alive():
-            try:
-                while True:
-                    self._out.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=0.05)
+        self._out.cancel()
+        self._thread.join()
 
 
 def _make_parser(cfg: FmConfig):

@@ -1,0 +1,352 @@
+"""Multiprocess parse workers: GIL-free ingest parsing.
+
+``parse_processes > 0`` moves batch parsing out of the trainer process
+into a pool of SPAWNED workers (never forked: a fork would inherit JAX's
+runtime threads and held locks; a spawned child imports only numpy + the
+data layer).  This is the rebuild's answer to the reference's free-running
+C++ ``FmParser`` threads: the pure-Python parse fallback is GIL-bound no
+matter what ``thread_num`` says, and even the ctypes path serializes its
+Python-side batch assembly — worker processes sidestep both.
+
+Parsed batches travel back over POSIX shared memory
+(``multiprocessing.shared_memory``): the worker lays the batch's
+contiguous numpy arrays (and, when host sort prep is on, the sort_meta
+arrays — all shapes are static given the config) into ONE segment and
+ships just the segment name over the result queue.  The parent maps the
+segment and wraps zero-copy views, so the only post-parse copy is
+``np.stack`` gathering the super-batch in ``stack_batches``.
+
+Segment lifecycle (Python 3.10: no ``track=False``):
+
+- the worker creates the segment, UNREGISTERS it from its resource
+  tracker (the segment must outlive the worker's queue turnover), writes,
+  and closes its own mapping;
+- the parent attaches, immediately ``unlink()``\\ s (the name disappears;
+  pages persist while mapped) and adopts the raw mmap out of the wrapper
+  (``_adopt_mapping``) — the views' .base chain then owns the mapping,
+  so the kernel reclaims the pages when the last view dies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import queue as _queue
+from multiprocessing import resource_tracker, shared_memory
+from typing import Optional
+
+import numpy as np
+
+from fast_tffm_tpu.data.libsvm import Batch, SortMeta
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerSpec:
+    """Everything a spawned worker needs to parse (picklable; no FmConfig
+    so children never import jax-adjacent modules)."""
+
+    vocabulary_size: int
+    max_features: int
+    hash_feature_id: bool
+    field_num: int
+    batch_size: int
+    use_native: bool  # parent's parser choice; children must match it
+    sort_meta_spec: Optional[tuple]  # (vocab, chunk, tile) or None
+
+
+_CORE = ("labels", "ids", "vals", "fields", "weights")
+_META = ("perm", "upos", "lrow_last", "starts", "firsts", "ends",
+         "tile_start")
+
+
+def _layout(spec: WorkerSpec):
+    """[(name, shape, dtype)] for the core batch and the sort_meta tail.
+
+    Every shape is static given the spec — n_pad/n_chunks/n_tiles mirror
+    native.sort_meta's padding math — so writer and reader agree on the
+    segment layout without shipping shapes per batch.
+    """
+    b, f = spec.batch_size, spec.max_features
+    core = [
+        ("labels", (b,), np.float32),
+        ("ids", (b, f), np.int32),
+        ("vals", (b, f), np.float32),
+        ("fields", (b, f), np.int32),
+        ("weights", (b,), np.float32),
+    ]
+    meta: list = []
+    if spec.sort_meta_spec is not None:
+        vocab, chunk, tile = spec.sort_meta_spec
+        n = b * f
+        n_pad = -(-n // chunk) * chunk
+        n_chunks = n_pad // chunk
+        n_tiles = vocab // tile
+        meta = [
+            ("perm", (n_pad,), np.int32),
+            ("upos", (n_pad,), np.int32),
+            ("lrow_last", (n_pad,), np.float32),
+            ("starts", (n_chunks,), np.int32),
+            ("firsts", (n_chunks + 1,), np.int32),
+            ("ends", (n_chunks,), np.int32),
+            ("tile_start", (n_tiles + 1,), np.int32),
+        ]
+    return core, meta
+
+
+def _nbytes(fields) -> int:
+    return sum(
+        int(np.prod(shape)) * np.dtype(dt).itemsize for _, shape, dt in fields
+    )
+
+
+def ship_batch(spec: WorkerSpec, batch: Batch, has_meta: bool) -> str:
+    """Worker side: copy one parsed batch into a fresh segment; returns
+    its name.  The worker's tracker registration is removed — the PARENT
+    owns cleanup (it unlinks on attach, or discard_segment on teardown)."""
+    core, meta = _layout(spec)
+    fields = core + (meta if has_meta else [])
+    shm = shared_memory.SharedMemory(create=True, size=max(1, _nbytes(fields)))
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # pragma: no cover - tracker impl drift
+        pass
+    off = 0
+    values = {name: getattr(batch, name) for name in _CORE}
+    if has_meta:
+        values.update(
+            {name: getattr(batch.sort_meta, name) for name in _META}
+        )
+    for name, shape, dt in fields:
+        count = int(np.prod(shape))
+        dst = np.frombuffer(shm.buf, dt, count=count, offset=off)
+        dst[:] = np.ascontiguousarray(values[name], dt).reshape(-1)
+        del dst
+        off += count * np.dtype(dt).itemsize
+    name = shm.name
+    shm.close()
+    return name
+
+
+def _adopt_mapping(shm: shared_memory.SharedMemory):
+    """Take ownership of the wrapper's mmap and neutralize the wrapper.
+
+    Binding the numpy views straight to the ``mmap`` object makes the
+    mapping's lifetime exactly the views' lifetime: the views hold the
+    mmap alive through their .base chain, and when the last one dies the
+    mmap deallocates (its buffer exports are gone by definition) and the
+    kernel reclaims the pages.  The SharedMemory wrapper cannot be left
+    to do this — its ``__del__`` calls ``close()``, which raises
+    BufferError while views still export the buffer — so its fd is
+    closed here (the mapping survives an fd close) and its fields are
+    cleared to make that ``__del__`` a no-op.
+    """
+    mm = shm._mmap
+    try:
+        shm._buf.release()  # never exported: views come from mm below
+    except Exception:  # pragma: no cover - buf impl drift
+        pass
+    try:
+        os.close(shm._fd)
+    except OSError:  # pragma: no cover - already closed
+        pass
+    shm._buf = None
+    shm._mmap = None
+    shm._fd = -1
+    return mm
+
+
+def attach_batch(spec: WorkerSpec, name: str, has_meta: bool) -> Batch:
+    """Parent side: map a shipped segment into zero-copy Batch views.
+
+    The segment is unlinked immediately (pages persist while mapped);
+    the mapping frees when the last field view is garbage collected, so
+    cached batches keep their pages exactly as long as the cache lives.
+    """
+    core, meta = _layout(spec)
+    fields = core + (meta if has_meta else [])
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        shm.unlink()
+    except FileNotFoundError:  # pragma: no cover - double-teardown race
+        pass
+    flat = np.frombuffer(_adopt_mapping(shm), np.uint8)
+    out = {}
+    off = 0
+    for name_, shape, dt in fields:
+        count = int(np.prod(shape))
+        nb = count * np.dtype(dt).itemsize
+        out[name_] = flat[off:off + nb].view(dt).reshape(shape)
+        off += nb
+    sort_meta = (
+        SortMeta(*(out[n] for n in _META)) if has_meta else None
+    )
+    return Batch(*(out[n] for n in _CORE), sort_meta=sort_meta)
+
+
+def discard_segment(name: str) -> None:
+    """Teardown path: unlink a shipped segment that will never be
+    attached (its worker already unregistered it from the tracker)."""
+    try:
+        shm = shared_memory.SharedMemory(name=name)
+    except FileNotFoundError:
+        return
+    try:
+        shm.unlink()
+    except FileNotFoundError:  # pragma: no cover
+        pass
+    shm.close()
+
+
+def put_with_stop(q, item, stop) -> bool:
+    """Bounded mp-queue put that gives up once ``stop`` is set — the
+    process-pool analogue of ``_ClosableQueue.put`` (an mp.Queue cannot
+    be cancelled, so the poll period bounds shutdown latency instead).
+    Shared by the pipeline's reader thread and the workers."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except _queue.Full:
+            continue
+    return False
+
+
+def _safe_exc(e: BaseException) -> BaseException:
+    """An exception guaranteed to survive the result queue's pickling
+    (an unpicklable error would be dropped by the feeder thread and the
+    failure would vanish)."""
+    try:
+        pickle.loads(pickle.dumps(e))
+        return e
+    except Exception:
+        return RuntimeError(f"{type(e).__name__}: {e}")
+
+
+def _build_parser(spec: WorkerSpec):
+    """(parse_lines_fn, parse_raw_fn, trunc_fn) for this worker."""
+    native_parser = None
+    if spec.use_native:
+        # The parent parsed natively; a child that silently fell back to
+        # the Python oracle could disagree bit-for-bit on edge tokens —
+        # fail loudly instead (same container, so this only fires when
+        # the build env genuinely changed under us).
+        from fast_tffm_tpu.data import native
+
+        native_parser = native.NativeParser(
+            vocabulary_size=spec.vocabulary_size,
+            max_features=spec.max_features,
+            hash_feature_id=spec.hash_feature_id,
+            field_num=spec.field_num,
+            num_threads=1,
+        )
+
+        def parse_lines(lines, weights):
+            return native_parser.parse_batch(
+                lines, spec.batch_size, weights
+            )
+
+        def parse_raw(buf, starts, ends):
+            return native_parser.parse_raw(
+                buf, starts, ends, spec.batch_size
+            )
+
+        def trunc():
+            return native_parser.truncated_features
+
+        return parse_lines, parse_raw, trunc
+
+    from fast_tffm_tpu.data import libsvm
+
+    def parse_lines_py(lines, weights):
+        examples = libsvm.parse_lines(
+            lines, spec.vocabulary_size, spec.hash_feature_id,
+            spec.field_num,
+        )
+        return libsvm.make_batch(
+            examples, spec.batch_size, spec.max_features, weights
+        )
+
+    def parse_raw_py(buf, starts, ends):  # pragma: no cover - guarded
+        raise RuntimeError("raw ingest requires the native parser")
+
+    return parse_lines_py, parse_raw_py, lambda: 0
+
+
+def parse_worker_main(spec: WorkerSpec, work, out, stop) -> None:
+    """Entry point of one spawned parse worker.
+
+    Work messages (from the pipeline's reader thread):
+      ("raw",   seq0, buf, [starts...], [ends...])  — one raw WINDOW,
+          sliced into len(starts) consecutive groups seq0, seq0+1, ...
+          (the window's bytes cross the queue once, not once per group);
+      ("lines", seq, lines, weights)                — one line-path chunk;
+      ("mark",  seq, epoch)                         — epoch marker, echoed;
+      None                                          — shutdown sentinel.
+
+    Result messages:
+      ("batch", seq, shm_name, has_meta, trunc_delta, note)
+      ("mark", seq, epoch) | ("err", exc) | ("done",)
+    """
+    parse_lines, parse_raw, trunc = _build_parser(spec)
+    meta_spec = spec.sort_meta_spec
+
+    def put(msg) -> bool:
+        return put_with_stop(out, msg, stop)
+
+    def emit(batch: Batch, seq: int, trunc_delta: int) -> bool:
+        nonlocal meta_spec
+        note = None
+        has_meta = False
+        if meta_spec is not None:
+            from fast_tffm_tpu.data import native
+
+            try:
+                batch = batch._replace(
+                    sort_meta=native.sort_meta(batch.ids, *meta_spec)
+                )
+                has_meta = True
+            except native.OutOfRangeIdsError as e:
+                note = ("oor", str(e))  # parent warns per bad batch
+            except Exception as e:
+                meta_spec = None  # this worker degrades for good
+                note = ("meta_failed", f"{type(e).__name__}: {e}")
+        shm_name = ship_batch(spec, batch, has_meta)
+        if put(("batch", seq, shm_name, has_meta, trunc_delta, note)):
+            return True
+        # Teardown raced the ship: the segment is already unregistered
+        # from this worker's tracker and nobody will ever attach it —
+        # unlink here or it outlives the run in /dev/shm.
+        discard_segment(shm_name)
+        return False
+
+    while not stop.is_set():
+        try:
+            msg = work.get(timeout=0.1)
+        except _queue.Empty:
+            continue
+        if msg is None:
+            put(("done",))
+            return
+        try:
+            kind = msg[0]
+            if kind == "mark":
+                if not put(msg):
+                    return
+                continue
+            if kind == "raw":
+                _, seq0, buf, starts_list, ends_list = msg
+                for j, (s, e) in enumerate(zip(starts_list, ends_list)):
+                    before = trunc()
+                    batch = parse_raw(buf, s, e)
+                    if not emit(batch, seq0 + j, trunc() - before):
+                        return
+            else:  # lines
+                _, seq, lines, weights = msg
+                before = trunc()
+                batch = parse_lines(lines, weights)
+                if not emit(batch, seq, trunc() - before):
+                    return
+        except BaseException as e:
+            if not put(("err", _safe_exc(e))):
+                return

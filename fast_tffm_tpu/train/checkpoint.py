@@ -64,6 +64,11 @@ def save(
         # boundary (staged-but-untrained prefetches re-parse on resume) —
         # and ``fingerprint``, the input-stream identity that gates
         # whether the position is honored (Trainer._data_fingerprint).
+        # The position feeds BatchPipeline(start_epoch, skip_batches)
+        # directly ("skip to position"); with cache_epochs the resumed
+        # pipeline re-parses epoch 0 once to rebuild the replay cache
+        # and later epochs come from memory, so the fingerprint includes
+        # the cache flag (toggling it redefines every epoch > 0).
         tmp = _data_state_path(model_file) + ".tmp"
         with open(tmp, "w") as f:
             json.dump(data_state, f)

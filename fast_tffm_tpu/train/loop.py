@@ -34,7 +34,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.libsvm import Batch
-from fast_tffm_tpu.data.pipeline import BatchPipeline, DevicePrefetcher
+from fast_tffm_tpu.data.pipeline import (
+    BatchPipeline, DevicePrefetcher, EpochEnd,
+)
 from fast_tffm_tpu.models import fm
 from fast_tffm_tpu.parallel import mesh as mesh_lib
 from fast_tffm_tpu.train import checkpoint, metrics as metrics_lib
@@ -542,145 +544,171 @@ class Trainer:
         # of NEW steps has elapsed since it last fired.  At K == 1 this
         # reduces exactly to the old per-step ``stepno % period == 0``.
         last_log_step = last_val_step = last_save_step = 0
-        trunc_base, trunc_logged = 0, 0
+        trunc_logged = 0
+        # ONE pipeline spans every remaining epoch of the run (the
+        # epoch-persistent ingest): the reader reseeds per epoch
+        # (seed + e, identical streams to the old one-pipeline-per-epoch
+        # construction), the resume position (start_epoch, skip_batches)
+        # lives inside the pipeline, and in-band EpochEnd markers carry
+        # the epoch boundaries out — so parser workers, the native
+        # parser, and (with cache_epochs) the parsed-batch cache all
+        # survive across epochs instead of being torn down per epoch.
+        #
+        # ordered=True always for training: delivery follows the
+        # (seeded, deterministic) reader order, so the saved
+        # batches_done position identifies EXACTLY the prefix that
+        # trained — with free-running workers a mid-epoch resume could
+        # double- or never-train boundary batches.  Parsing still fans
+        # out to thread_num workers (sequence-numbered delivery), so
+        # this costs no throughput.
+        self._epoch = resume_epoch
+        self._batches_done = resume_skip
+        pipeline = BatchPipeline(
+            cfg.train_files,
+            pipe_cfg,
+            weight_files=cfg.weight_files or None,
+            epochs=cfg.epoch_num,
+            shuffle=True,
+            seed=cfg.seed,
+            start_epoch=resume_epoch,
+            skip_batches=resume_skip,
+            shard=shard,
+            ordered=True,
+            sort_meta_spec=self._sort_meta_spec(),
+            cache_epochs=cfg.cache_epochs,
+            cache_max_bytes=cfg.cache_max_bytes,
+            epoch_marks=True,
+        )
+        # Transfer stage: a background thread stacks K parsed batches
+        # and ships super-batch n+1 (shard + device_put) while n trains;
+        # an epoch's tail arrives as one short super-batch (K' =
+        # leftover, the EpochEnd marker flushes the group), so every
+        # batch trains exactly once and ``batches_done`` only ever
+        # advances by whole dispatches — a saved position always lands
+        # on a super-batch boundary.
+        prefetcher = DevicePrefetcher(
+            pipeline, k, self._put_super,
+            depth=cfg.prefetch_super_batches,
+        )
+        cache_logged = not cfg.cache_epochs
         try:
-            for epoch in range(resume_epoch, cfg.epoch_num):
-                self._epoch = epoch
-                self._batches_done = resume_skip if epoch == resume_epoch else 0
-                # ordered=True always for training: delivery follows the
-                # (seeded, deterministic) reader order, so the saved
-                # batches_done position identifies EXACTLY the prefix that
-                # trained — with free-running workers a mid-epoch resume
-                # could double- or never-train boundary batches.  Parsing
-                # still fans out to thread_num workers (sequence-numbered
-                # delivery), so this costs no throughput.
-                pipeline = BatchPipeline(
-                    cfg.train_files,
-                    pipe_cfg,
-                    weight_files=cfg.weight_files or None,
-                    epochs=1,
-                    shuffle=True,
-                    seed=cfg.seed + epoch,
-                    skip_batches=self._batches_done,
-                    shard=shard,
-                    ordered=True,
-                    sort_meta_spec=self._sort_meta_spec(),
-                )
-                # Transfer stage: a background thread stacks K parsed
-                # batches and ships super-batch n+1 (shard + device_put)
-                # while n trains; the epoch tail arrives as one short
-                # super-batch (K' = leftover), so every batch trains
-                # exactly once and ``batches_done`` only ever advances by
-                # whole dispatches — a saved position always lands on a
-                # super-batch boundary.
-                prefetcher = DevicePrefetcher(
-                    pipeline, k, self._put_super,
-                    depth=cfg.prefetch_super_batches,
-                )
-                try:
-                    for super_batch, kk in prefetcher:
-                        if (
-                            cfg.profile_dir
-                            and not profile_started
-                            and stepno >= cfg.profile_start_step
-                        ):
-                            jax.profiler.start_trace(cfg.profile_dir)
-                            profiling = profile_started = True
-                            profile_stop_at = stepno + cfg.profile_steps
-                        # ONE dispatch = kk fused train steps (lax.scan).
-                        self.state = self._scan_train_step(
-                            self.state, super_batch
+            try:
+                for item in prefetcher:
+                    if isinstance(item, EpochEnd):
+                        self._epoch = item.epoch + 1
+                        self._batches_done = 0
+                        if not cache_logged:
+                            # The cache outcome is known once epoch 0
+                            # finishes parsing; surface it exactly once.
+                            cache_logged = True
+                            log.info(
+                                "ingest cache after epoch %d: %s",
+                                item.epoch, pipeline.cache_result,
+                            )
+                        continue
+                    super_batch, kk = item
+                    if (
+                        cfg.profile_dir
+                        and not profile_started
+                        and stepno >= cfg.profile_start_step
+                    ):
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        profiling = profile_started = True
+                        profile_stop_at = stepno + cfg.profile_steps
+                    # ONE dispatch = kk fused train steps (lax.scan).
+                    self.state = self._scan_train_step(
+                        self.state, super_batch
+                    )
+                    stepno += kk
+                    self._batches_done += kk
+                    if profiling and stepno >= profile_stop_at:
+                        jax.block_until_ready(self.state)
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        log.info(
+                            "profiler trace written to %s",
+                            cfg.profile_dir,
                         )
-                        stepno += kk
-                        self._batches_done += kk
-                        if profiling and stepno >= profile_stop_at:
-                            jax.block_until_ready(self.state)
-                            jax.profiler.stop_trace()
-                            profiling = False
-                            log.info(
-                                "profiler trace written to %s",
-                                cfg.profile_dir,
+                    if (
+                        cfg.log_steps
+                        and stepno - last_log_step >= cfg.log_steps
+                    ):
+                        last_log_step = stepno
+                        # Examples come from the on-device weight sum —
+                        # the GLOBAL count in multi-host runs (each host
+                        # only sees its local shard).
+                        m = _finalize_metrics(
+                            self.state.metrics, cfg.loss_type
+                        )
+                        now = time.time()
+                        rate = (m["examples"] - last_log_ex) / max(
+                            now - last_log_t, 1e-9
+                        )
+                        last_log_t, last_log_ex = now, m["examples"]
+                        log.info(
+                            "step %d examples %d loss %.6f auc %.4f "
+                            "ex/s %.0f",
+                            stepno, int(m["examples"]), m["loss"],
+                            m["auc"], rate,
+                        )
+                        # Surface parser truncation (reference FmParser
+                        # warned; silently vanishing features hide data
+                        # bugs like a too-small max_features).  The
+                        # counter spans the whole run now — it folds in
+                        # process-worker drops and cached-epoch replays.
+                        cur_trunc = pipeline.truncated_features
+                        if cur_trunc > trunc_logged:
+                            log.warning(
+                                "%d feature occurrences dropped by "
+                                "max_features=%d since last report "
+                                "(total %d)",
+                                cur_trunc - trunc_logged,
+                                cfg.max_features, cur_trunc,
                             )
-                        if (
-                            cfg.log_steps
-                            and stepno - last_log_step >= cfg.log_steps
-                        ):
-                            last_log_step = stepno
-                            # Examples come from the on-device weight sum —
-                            # the GLOBAL count in multi-host runs (each host
-                            # only sees its local shard).
-                            m = _finalize_metrics(
-                                self.state.metrics, cfg.loss_type
-                            )
-                            now = time.time()
-                            rate = (m["examples"] - last_log_ex) / max(
-                                now - last_log_t, 1e-9
-                            )
-                            last_log_t, last_log_ex = now, m["examples"]
-                            log.info(
-                                "step %d examples %d loss %.6f auc %.4f "
-                                "ex/s %.0f",
-                                stepno, int(m["examples"]), m["loss"],
-                                m["auc"], rate,
-                            )
-                            # Surface parser truncation (reference FmParser
-                            # warned; silently vanishing features hide data
-                            # bugs like a too-small max_features).
-                            cur_trunc = (
-                                trunc_base + pipeline.truncated_features
-                            )
-                            if cur_trunc > trunc_logged:
-                                log.warning(
-                                    "%d feature occurrences dropped by "
-                                    "max_features=%d since last report "
-                                    "(total %d)",
-                                    cur_trunc - trunc_logged,
-                                    cfg.max_features, cur_trunc,
-                                )
-                                trunc_logged = cur_trunc
-                            if metrics_out is not None:
-                                metrics_out.write(json.dumps({
-                                    "step": stepno,
-                                    "examples": m["examples"],
-                                    "loss": m["loss"],
-                                    "auc": m["auc"],
-                                    "examples_per_sec": rate,
-                                    "elapsed": now - t0,
-                                }) + "\n")
-                                metrics_out.flush()
-                        if (
-                            cfg.validation_steps
-                            and cfg.validation_files
-                            and stepno - last_val_step >= cfg.validation_steps
-                        ):
-                            last_val_step = stepno
-                            vm = self.evaluate(cfg.validation_files)
-                            log.info(
-                                "step %d validation loss %.6f auc %.4f",
-                                stepno, vm["loss"], vm["auc"],
-                            )
-                            if metrics_out is not None:
-                                metrics_out.write(json.dumps({
-                                    "step": stepno,
-                                    "validation_loss": vm["loss"],
-                                    "validation_auc": vm["auc"],
-                                }) + "\n")
-                                metrics_out.flush()
-                        if (
-                            cfg.save_steps
-                            and stepno - last_save_step >= cfg.save_steps
-                        ):
-                            last_save_step = stepno
-                            self.save(stepno)
-                finally:
-                    prefetcher.close()
-                trunc_base += pipeline.truncated_features
+                            trunc_logged = cur_trunc
+                        if metrics_out is not None:
+                            metrics_out.write(json.dumps({
+                                "step": stepno,
+                                "examples": m["examples"],
+                                "loss": m["loss"],
+                                "auc": m["auc"],
+                                "examples_per_sec": rate,
+                                "elapsed": now - t0,
+                            }) + "\n")
+                            metrics_out.flush()
+                    if (
+                        cfg.validation_steps
+                        and cfg.validation_files
+                        and stepno - last_val_step >= cfg.validation_steps
+                    ):
+                        last_val_step = stepno
+                        vm = self.evaluate(cfg.validation_files)
+                        log.info(
+                            "step %d validation loss %.6f auc %.4f",
+                            stepno, vm["loss"], vm["auc"],
+                        )
+                        if metrics_out is not None:
+                            metrics_out.write(json.dumps({
+                                "step": stepno,
+                                "validation_loss": vm["loss"],
+                                "validation_auc": vm["auc"],
+                            }) + "\n")
+                            metrics_out.flush()
+                    if (
+                        cfg.save_steps
+                        and stepno - last_save_step >= cfg.save_steps
+                    ):
+                        last_save_step = stepno
+                        self.save(stepno)
+            finally:
+                prefetcher.close()
             self._epoch = cfg.epoch_num
             self._batches_done = 0
-            if trunc_base > trunc_logged:
+            total_trunc = pipeline.truncated_features
+            if total_trunc > trunc_logged:
                 log.warning(
                     "%d feature occurrences dropped by max_features=%d "
-                    "over the run", trunc_base, cfg.max_features,
+                    "over the run", total_trunc, cfg.max_features,
                 )
         finally:
             # An abandoned trace poisons any later start_trace in-process.
@@ -693,6 +721,9 @@ class Trainer:
             train_metrics["examples"] / max(time.time() - t0, 1e-9)
         )
         train_metrics["steps"] = stepno
+        # Cache observability rides the result too ("off" | "cached" |
+        # "overflow") so sweeps can tell which runs actually replayed.
+        train_metrics["ingest_cache"] = pipeline.cache_result
         self.save(stepno)
         result = {"train": train_metrics}
         if cfg.validation_files:
@@ -730,6 +761,10 @@ class Trainer:
             "train_files": list(self.cfg.train_files),
             "shuffle_buffer": self.cfg.shuffle_buffer,
             "fast_ingest": self.cfg.fast_ingest,
+            # Cached replays permute epoch-0 BATCHES per epoch while
+            # streaming re-shuffles LINES — toggling the cache redefines
+            # every epoch > 0, so a saved position must not survive it.
+            "cache_epochs": self.cfg.cache_epochs,
         }
 
     def save(self, stepno: int):

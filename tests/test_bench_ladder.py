@@ -129,6 +129,37 @@ def test_ladder_real_trainer_injected_step_failure(tmp_path):
     assert any("Pallas TPU lowering" in e for e in errors)
 
 
+def test_probe_short_circuits_on_cpu_pin(monkeypatch):
+    """JAX_PLATFORMS=cpu means there is no tunnel to probe: the probe
+    must return instantly WITHOUT spawning a subprocess (a CPU-only box
+    used to burn the probe timeout dialing a dead tunnel and pollute
+    the result JSON with a timeout error — BENCH_r05)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def no_spawn(*a, **kw):  # pragma: no cover - the assertion
+        raise AssertionError("probe spawned a subprocess despite cpu pin")
+
+    monkeypatch.setattr(bench.subprocess, "run", no_spawn)
+    plat, _n, err = bench._probe_backend()
+    assert plat == "cpu" and err is None
+
+
+def test_probe_timeout_single_attempt_sane_deadline(monkeypatch):
+    """A hung tunnel gets ONE bounded probe (90 s default, down from
+    240) and no full-timeout retries."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(kw.get("timeout"))
+        raise bench.subprocess.TimeoutExpired(cmd=cmd, timeout=kw["timeout"])
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    plat, _n, err = bench._probe_backend()
+    assert plat is None and "timed out" in err
+    assert calls == [90]
+
+
 def test_watchdog_kills_hung_child_and_reports(tmp_path, monkeypatch):
     """A child that never returns (mid-run tunnel death) must be killed at
     the deadline, not waited on forever; the reason reaches the caller."""

@@ -1,0 +1,320 @@
+"""Epoch-persistent ingest (ISSUE 2 tentpole): ONE BatchPipeline spans
+all epochs of a run, the trainer adopts the parsed-batch cache behind
+``cache_epochs``, and mid-epoch resume is cache-aware.
+
+Pins the guarantees the restructure makes:
+
+  * overflow fallback — blowing ``cache_max_bytes`` streams the
+    remaining epochs with the SAME per-epoch seeds as an uncached run
+    (byte-identical stream, not just same coverage),
+  * cache-aware resume — a pipeline (and a Trainer) resumed mid-epoch of
+    a cached multi-epoch run delivers exactly the uninterrupted run's
+    remaining batch sequence (the Trainer check is bitwise on params),
+  * marker hygiene — EpochEnd markers flush the DevicePrefetcher's
+    pending group so super-batches never span epochs,
+  * truncation accounting — cached replays and process workers keep the
+    ``truncated_features`` counter truthful.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.libsvm import Batch
+from fast_tffm_tpu.data.pipeline import (
+    BatchPipeline, DevicePrefetcher, EpochEnd,
+)
+from fast_tffm_tpu.train import checkpoint
+from fast_tffm_tpu.train.loop import Trainer
+
+from test_scan_loop import _interrupt_after_dispatches, _tree_equal
+
+
+def _write_data(path, rng, lines=320, vocab=64):
+    with open(path, "w") as f:
+        for i in range(lines):
+            f.write(
+                f"{i % 2} {rng.integers(0, vocab)}:1 "
+                f"{rng.integers(0, vocab)}:0.5\n"
+            )
+
+
+def _cfg(tmp_path, **kw):
+    defaults = dict(
+        vocabulary_size=64, factor_num=4, max_features=4, batch_size=32,
+        train_files=[str(tmp_path / "train.libsvm")],
+        model_file=str(tmp_path / "model"),
+        epoch_num=1, log_steps=0, thread_num=1, seed=3,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+def _keys(pipe):
+    out = []
+    for b in pipe:
+        if isinstance(b, EpochEnd):
+            out.append(("mark", b.epoch))
+        else:
+            out.append((b.labels.tobytes(), b.ids.tobytes(),
+                        b.vals.tobytes(), b.weights.tobytes()))
+    return out
+
+
+# ------------------------------------------------------------ pipeline
+
+
+def test_cache_overflow_streams_with_per_epoch_seeds(tmp_path, rng):
+    """Overflow fallback must reproduce the uncached multi-epoch stream
+    byte-for-byte: epoch e re-parses under seed + e exactly like a run
+    that never cached (not merely 'covers the data')."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, thread_num=2)
+    files = cfg.train_files
+    plain = _keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True, epoch_marks=True,
+    ))
+    over = BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True, epoch_marks=True,
+        cache_epochs=True, cache_max_bytes=1,
+    )
+    got = _keys(over)
+    assert over.cache_result == "overflow"
+    assert got == plain
+
+
+def test_cached_pipeline_resume_matches_fresh_run(tmp_path, rng):
+    """Resume at (epoch 1, batch 3) of a cached 3-epoch run delivers
+    exactly the fresh run's stream from that position: the resumed
+    pipeline re-parses epoch 0 to REBUILD the cache (delivering
+    nothing), then replays the same per-epoch permutations."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, thread_num=2)
+    files = cfg.train_files
+    full = _keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True,
+        cache_epochs=True, epoch_marks=True,
+    ))
+    resumed = _keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True,
+        cache_epochs=True, epoch_marks=True, start_epoch=1, skip_batches=3,
+    ))
+    i = full.index(("mark", 0))
+    assert resumed == full[i + 1 + 3:]
+
+
+def test_cached_resume_with_overflow_matches_streaming_resume(
+    tmp_path, rng
+):
+    """A resumed run whose cache rebuild ALSO overflows falls back to
+    streaming the resume epoch from its own seed with the skip — the
+    same stream the uninterrupted overflow run delivered there."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, thread_num=2)
+    files = cfg.train_files
+    plain = _keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True, epoch_marks=True,
+    ))
+    resumed = _keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True, epoch_marks=True,
+        cache_epochs=True, cache_max_bytes=1, start_epoch=1,
+        skip_batches=3,
+    ))
+    i = plain.index(("mark", 0))
+    assert resumed == plain[i + 1 + 3:]
+
+
+def test_pipeline_start_epoch_streams_remaining_epochs(tmp_path, rng):
+    """Uncached start_epoch: epochs e0..E-1 stream under their own
+    seeds — identical to the suffix of the full run."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path)
+    files = cfg.train_files
+    full = _keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True, epoch_marks=True,
+    ))
+    tail = _keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True, epoch_marks=True,
+        start_epoch=2,
+    ))
+    i = full.index(("mark", 1))
+    assert tail == full[i + 1:]
+
+
+def test_truncation_accumulates_across_cached_replays(tmp_path, rng):
+    """Cached replays deliver batches whose parse dropped features; each
+    replay epoch re-adds epoch 0's truncation so the trainer's periodic
+    warning reports what a re-parse would have dropped."""
+    path = tmp_path / "t.libsvm"
+    with open(path, "w") as f:
+        for i in range(64):  # 6 features, max_features=4 -> 2 dropped
+            toks = " ".join(f"{(i + j) % 64}:1.0" for j in range(6))
+            f.write(f"{i % 2} {toks}\n")
+    cfg = _cfg(tmp_path, max_features=4)
+    pipe = BatchPipeline(
+        [str(path)], cfg, epochs=3, shuffle=True, ordered=True,
+        cache_epochs=True,
+    )
+    n = sum(1 for b in pipe if not isinstance(b, EpochEnd))
+    assert n == 6  # 2 batches x 3 epochs
+    assert pipe.truncated_features == 3 * 128
+
+
+def test_proc_pipeline_early_close_leaves_no_shm(tmp_path, rng):
+    """Abandoning a process-worker pipeline mid-stream (training
+    exception, prefetcher close, cache-rebuild early break) must not
+    strand segments in /dev/shm: workers unlink what teardown raced,
+    the parent drains what the workers shipped."""
+    import os
+
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, parse_processes=2, queue_size=2)
+    before = {n for n in os.listdir("/dev/shm") if n.startswith("psm_")}
+    it = iter(BatchPipeline(
+        cfg.train_files, cfg, epochs=2, shuffle=True, ordered=True,
+    ))
+    next(it)  # pool running, queues filling
+    it.close()  # early teardown runs the full finally chain
+    after = {n for n in os.listdir("/dev/shm") if n.startswith("psm_")}
+    assert after - before == set()
+
+
+def test_truncation_counted_from_process_workers(tmp_path, rng):
+    """Process workers parse in children; their drop counts must ship
+    back with the batches (the parent's native counter never moves)."""
+    path = tmp_path / "t.libsvm"
+    with open(path, "w") as f:
+        for i in range(64):
+            toks = " ".join(f"{(i + j) % 64}:1.0" for j in range(6))
+            f.write(f"{i % 2} {toks}\n")
+    cfg = _cfg(tmp_path, max_features=4, parse_processes=1)
+    pipe = BatchPipeline([str(path)], cfg, epochs=1, shuffle=False,
+                         ordered=True)
+    assert sum(1 for _ in pipe) == 2
+    assert pipe.truncated_features == 128
+
+
+# ------------------------------------------------- prefetcher + markers
+
+
+def _batch(rng, b=32, f=4, vocab=64):
+    return Batch(
+        labels=rng.integers(0, 2, b).astype(np.float32),
+        ids=rng.integers(0, vocab, (b, f)).astype(np.int32),
+        vals=rng.uniform(0.1, 1.0, (b, f)).astype(np.float32),
+        fields=np.zeros((b, f), np.int32),
+        weights=np.ones((b,), np.float32),
+    )
+
+
+def test_prefetcher_flushes_group_at_epoch_mark(rng):
+    """An EpochEnd flushes the pending group (epoch tail at K' =
+    leftover) and is forwarded in position — super-batches never span
+    epochs, so every checkpointed position stays within one epoch."""
+    batches = [_batch(rng) for _ in range(5)]
+    src = batches[:3] + [EpochEnd(0)] + batches[3:] + [EpochEnd(1)]
+    got = list(DevicePrefetcher(src, 2, lambda b: b, depth=4))
+    shape = [x.epoch if isinstance(x, EpochEnd) else x[1] for x in got]
+    assert shape == [2, 1, 0, 2, 1]  # K, K'=1, mark0, K, mark1
+    np.testing.assert_array_equal(got[1][0].ids[0], batches[2].ids)
+
+
+# --------------------------------------------------------------- trainer
+
+
+def test_trainer_cache_epochs_trains_and_reports(tmp_path, rng, caplog):
+    """Trainer adoption: a cached multi-epoch run trains every batch of
+    every epoch, logs the cache outcome once, and surfaces it in the
+    result dict."""
+    _write_data(tmp_path / "train.libsvm", rng)  # 10 batches
+    cfg = _cfg(tmp_path, epoch_num=3, cache_epochs=True)
+    with caplog.at_level(logging.INFO):
+        r = Trainer(cfg).train()
+    assert r["train"]["steps"] == 30
+    assert r["train"]["examples"] == 3 * 320.0
+    assert r["train"]["ingest_cache"] == "cached"
+    msgs = [rec.getMessage() for rec in caplog.records]
+    assert any("ingest cache after epoch 0: cached" in m for m in msgs)
+
+
+def test_trainer_cached_midepoch_resume_bitwise(tmp_path, rng):
+    """THE acceptance check: a checkpoint written mid-epoch-1 of a
+    cached 3-epoch run resumes to a bitwise-identical batch stream —
+    asserted through the strictest observable, final params equality
+    against the uninterrupted run."""
+    _write_data(tmp_path / "train.libsvm", rng)  # 10 batches/epoch
+    kw = dict(epoch_num=3, cache_epochs=True, steps_per_dispatch=2)
+    full = Trainer(_cfg(tmp_path, model_file=str(tmp_path / "m_full"),
+                        **kw))
+    rf = full.train()
+    assert rf["train"]["steps"] == 30
+
+    cfg = _cfg(tmp_path, model_file=str(tmp_path / "m_int"),
+               save_steps=2, **kw)
+    t = Trainer(cfg)
+    _interrupt_after_dispatches(t, 7)  # 14 batches: mid-epoch 1
+    with pytest.raises(KeyboardInterrupt):
+        t.train()
+    ds = checkpoint.restore_data_state(cfg.model_file)
+    assert ds["epoch"] == 1 and ds["batches_done"] == 4
+
+    t2 = Trainer(cfg)
+    r2 = t2.train()
+    assert r2["train"]["steps"] == 16  # exactly the remaining batches
+    # Params are the strictest stream observable (metrics are not
+    # checkpointed — a resumed run accumulates only its own steps).
+    assert _tree_equal(t2.state.params, full.state.params)
+
+
+def test_trainer_uncached_multiepoch_unchanged(tmp_path, rng):
+    """The single-pipeline restructure must not change the uncached
+    stream: per-epoch reseeding inside the pipeline reproduces the old
+    one-pipeline-per-epoch run's data order (checked via params against
+    a resume mid-epoch-2, crossing an epoch boundary)."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    kw = dict(epoch_num=3,)
+    full = Trainer(_cfg(tmp_path, model_file=str(tmp_path / "m_f"), **kw))
+    full.train()
+
+    cfg = _cfg(tmp_path, model_file=str(tmp_path / "m_i"), save_steps=1,
+               **kw)
+    t = Trainer(cfg)
+    _interrupt_after_dispatches(t, 23)  # epoch 2, batch 3
+    with pytest.raises(KeyboardInterrupt):
+        t.train()
+    ds = checkpoint.restore_data_state(cfg.model_file)
+    assert ds["epoch"] == 2 and ds["batches_done"] == 3
+    t2 = Trainer(cfg)
+    r2 = t2.train()
+    assert r2["train"]["steps"] == 7
+    assert _tree_equal(t2.state.params, full.state.params)
+
+
+def test_trainer_parse_processes_bitwise(tmp_path, rng):
+    """A train() through the process-worker pool is bitwise identical
+    to the in-process parse (same batches, same order, same params)."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    tt = Trainer(_cfg(tmp_path, model_file=str(tmp_path / "m_t")))
+    tt.train()
+    tp = Trainer(_cfg(tmp_path, model_file=str(tmp_path / "m_p"),
+                      parse_processes=1))
+    tp.train()
+    assert _tree_equal(tt.state.params, tp.state.params)
+    assert _tree_equal(tt.state.metrics, tp.state.metrics)
+
+
+def test_fingerprint_rejects_cache_toggle(tmp_path, rng):
+    """Toggling cache_epochs redefines every epoch > 0 (batch-permuted
+    replay vs line-level reshuffle), so a saved mid-run position under
+    the other setting must be ignored, not resumed into wrong data."""
+    from conftest import set_data_state
+
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, epoch_num=2, cache_epochs=True)
+    Trainer(cfg).train()
+    set_data_state(cfg.model_file, epoch=1, batches_done=3)
+    cfg2 = _cfg(tmp_path, epoch_num=2, cache_epochs=False)
+    r = Trainer(cfg2).train()
+    assert r["train"]["steps"] == 20  # position ignored: full fresh run

@@ -400,6 +400,9 @@ def test_cache_epochs_ignored_for_single_epoch_and_sharded(data_files):
     p2 = BatchPipeline(data_files, cfg, epochs=2, cache_epochs=True,
                        shard=(0, 2))
     assert not p2._cache_epochs
+    # A resume position no longer disables the cache: the cached path
+    # re-parses epoch 0 to rebuild the replay cache (skip applies to
+    # delivery only), so resumed runs replay later epochs from memory.
     p3 = BatchPipeline(data_files, cfg, epochs=2, cache_epochs=True,
                        skip_batches=1)
-    assert not p3._cache_epochs
+    assert p3._cache_epochs

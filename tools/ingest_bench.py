@@ -198,6 +198,25 @@ def main() -> int:
                  slowest_s=round(slowest, 2),
                  cores=os.cpu_count())
 
+        # In-pipeline process POOL (parse_processes, PR 2): unlike the
+        # independent-shard "procs" stage above, this is ONE pipeline —
+        # one reader, N spawned parse workers, parsed batches returning
+        # over shared memory as a single trainable stream.  The rate the
+        # trainer sees when the GIL (or the Python parse fallback) is
+        # the bottleneck.
+        for np_ in (1, 2, 4):
+            cfg = FmConfig(
+                vocabulary_size=VOCAB, factor_num=8, max_features=NFEAT,
+                batch_size=BATCH, queue_size=8, parse_processes=np_,
+            )
+            pipe = BatchPipeline(files, cfg, epochs=1, shuffle=True)
+            t0 = time.perf_counter()
+            n = 0
+            for _b in pipe:
+                n += BATCH
+            emit("pipeline-procpool", n / (time.perf_counter() - t0),
+                 parse_processes=np_, cores=os.cpu_count())
+
         # Pipeline with per-batch sort_meta on the workers: what the
         # training path actually runs when host_sort engages.
         for tn in (4, 8):
