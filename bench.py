@@ -339,7 +339,7 @@ def _bench_parse_only(files, cfg) -> float:
 
 
 def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
-               k: int = 1) -> tuple:
+               k: int = 1, telemetry_enabled: bool = True) -> tuple:
     """Examples/sec through BatchPipeline + DevicePrefetcher — the
     train() hot path: parse threads, the stacking/H2D transfer thread,
     and the K-step fused dispatch all overlapped.  ``warmup`` counts
@@ -351,17 +351,28 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     CPU boxes; a tight TPU tunnel host) re-parsing identical text
     every epoch is pure overhead no overlap can hide.
 
-    Returns (overall_rate, cache_result, epoch0_rate, cached_rate):
-    the pipeline's in-band EpochEnd markers split the run into per-epoch
-    windows (draining the device at each marker so the window measures
-    completed training, not enqueue speed) — epoch 0 pays the parse,
-    epochs 1+ replay from the cache, and their gap is exactly what the
-    cache buys.
+    Returns (overall_rate, cache_result, epoch0_rate, cached_rate,
+    tele_report): the pipeline's in-band EpochEnd markers split the run
+    into per-epoch windows (draining the device at each marker so the
+    window measures completed training, not enqueue speed) — epoch 0
+    pays the parse, epochs 1+ replay from the cache, and their gap is
+    exactly what the cache buys.
+
+    ``tele_report`` is the run's obs.Telemetry self-report: the final
+    stage snapshot plus ``ingest_wait_frac`` over the TIMED region —
+    the same per-stage attribution a training run's heartbeat emits,
+    measured here instead of re-derived with bench-local stopwatches.
+    With ``telemetry_enabled=False`` the run uses no-op instruments
+    (the on/off rate ratio is the layer's measured overhead).
     """
+    from fast_tffm_tpu import obs
     from fast_tffm_tpu.data.pipeline import (
         BatchPipeline, DevicePrefetcher, EpochEnd,
     )
 
+    tel = obs.Telemetry(enabled=telemetry_enabled)
+    t_wait = tel.timer("train.wait_input")
+    t_disp = tel.timer("train.dispatch")
     # The dataset (not epochs) bounds the cache: size the budget to hold
     # it so the reported ingest_cache outcome only says "overflow" when
     # the files genuinely outgrow host memory expectations.  ordered=True
@@ -370,6 +381,7 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     pipeline = BatchPipeline(
         files, cfg, epochs=epochs, shuffle=True, ordered=True,
         cache_epochs=True, cache_max_bytes=4 << 30, epoch_marks=True,
+        telemetry=tel,
     )
 
     # Real-example counts ride the host stack (transfer thread), keeping
@@ -381,7 +393,7 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
         )
 
     prefetcher = DevicePrefetcher(
-        pipeline, k, put, depth=cfg.prefetch_super_batches
+        pipeline, k, put, depth=cfg.prefetch_super_batches, telemetry=tel,
     )
     it = iter(prefetcher)
     epoch_rates: dict[int, float] = {}
@@ -396,9 +408,16 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
             warmed += kk
         _drain(trainer.state)
         n = 0
+        # Wall-clock attribution over the timed region only: subtract
+        # the warmup's accumulated wait/dispatch totals.
+        wait0, disp0 = t_wait.total_s, t_disp.total_s
         t0 = time.perf_counter()
         n_mark, t_mark = 0, t0
-        for item in it:
+        while True:
+            with t_wait.time():
+                item = next(it, None)
+            if item is None:
+                break
             if isinstance(item, EpochEnd):
                 _drain(trainer.state)
                 now = time.perf_counter()
@@ -409,7 +428,8 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
                 n_mark, t_mark = n, now
                 continue
             (sb, n_real), kk = item
-            trainer.state = trainer._scan_train_step(trainer.state, sb)
+            with t_disp.time():
+                trainer.state = trainer._scan_train_step(trainer.state, sb)
             n += n_real
         _drain(trainer.state)
         dt = time.perf_counter() - t0
@@ -418,8 +438,18 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     epoch0 = epoch_rates.get(0, 0.0)
     replays = [r for e, r in epoch_rates.items() if e > 0]
     cached = float(np.median(replays)) if replays else 0.0
+    wait_s = t_wait.total_s - wait0
+    disp_s = t_disp.total_s - disp0
+    tele_report = {
+        "ingest_wait_frac": round(wait_s / max(dt, 1e-9), 4),
+        "wait_input_s": round(wait_s, 3),
+        "dispatch_s": round(disp_s, 3),
+        "timed_wall_s": round(dt, 3),
+        "stages": tel.snapshot(),
+    }
     return (
         (n / dt if dt > 0 else 0.0), pipeline.cache_result, epoch0, cached,
+        tele_report,
     )
 
 
@@ -482,6 +512,8 @@ def main() -> int:
     ingest_threads_rate, ingest_procs_rate = 0.0, 0.0
     bench_procs = 0
     ingest_cache = "off"
+    tele_report = None
+    e2e_tel_off = 0.0
     bf16_rung, bf16_errors = None, []
     e2e_err = None
     cfg = None
@@ -588,7 +620,7 @@ def main() -> int:
                     # from the same span.
                     rounds = 1 if on_tpu else 3
                     s_samples, s1_samples, e_samples = [], [], []
-                    e0_samples, ec_samples = [], []
+                    e0_samples, ec_samples, off_samples = [], [], []
                     for _ in range(rounds):
                         s1_samples.append(_bench_step_only(
                             trainer, cfg, steps
@@ -596,13 +628,25 @@ def main() -> int:
                         s_samples.append(_bench_step_scan(
                             trainer, cfg, max(steps, 2 * K), K
                         ))
-                        r, ingest_cache, r0, rc = _bench_e2e(
+                        r, ingest_cache, r0, rc, tele_report = _bench_e2e(
                             trainer, cfg, files, warmup=4, epochs=epochs,
                             k=K,
                         )
                         e_samples.append(r)
                         e0_samples.append(r0)
                         ec_samples.append(rc)
+                        # Telemetry overhead probe, PAIRED: the identical
+                        # K=8 e2e with no-op instruments runs inside the
+                        # same round, so the on/off ratio feeds both
+                        # medians from the same machine-state span
+                        # instead of handing run-to-run drift to a
+                        # single trailing off-run.
+                        off_r, _, _, _, _ = _bench_e2e(
+                            trainer, cfg, files, warmup=4, epochs=epochs,
+                            k=K, telemetry_enabled=False,
+                        )
+                        off_samples.append(off_r)
+                    e2e_tel_off = float(np.median(off_samples))
                     # All three medians feed from the same windows, so
                     # the derived dispatch_overhead_ms and e2e/step split
                     # compare like with like.
@@ -613,7 +657,7 @@ def main() -> int:
                     e2e_cached = float(np.median(ec_samples))
                     # K=1 comparison point (the classic per-batch loop,
                     # now also through the transfer stage).
-                    e2e_rate_k1, _, _, _ = _bench_e2e(
+                    e2e_rate_k1, _, _, _, _ = _bench_e2e(
                         trainer, cfg, files, warmup=4, epochs=epochs, k=1
                     )
                     # parse_processes scaling: drain the bare pipeline
@@ -711,6 +755,12 @@ def main() -> int:
         "dispatch_overhead_ms": round(dispatch_overhead_ms, 3),
         "h2d_overlap_frac": round(h2d_overlap_frac, 4),
         "ingest_cache": ingest_cache,  # "cached" | "overflow" | "off"
+        # Telemetry overhead: the same K=8 e2e run with instruments
+        # disabled; on/off ≈ 1.0 means the layer costs noise-level time.
+        "e2e_telemetry_off_examples_per_sec": round(e2e_tel_off, 1),
+        "telemetry_on_vs_off": round(
+            e2e_rate / e2e_tel_off, 4
+        ) if e2e_tel_off > 0 and e2e_rate > 0 else 0.0,
         "parse_lines_per_sec": round(parse_rate, 1),
         # Bare-pipeline drain rates: thread workers vs a spawned
         # parse-process pool on the same files (GIL-free scaling probe).
@@ -724,6 +774,14 @@ def main() -> int:
         "platform": platform,
         "n_chips": n_chips,
     }
+    if tele_report is not None:
+        # The judged e2e run's per-stage self-report (what a training
+        # heartbeat would have emitted): ingest_wait_frac + queue depths
+        # + parse/stack/H2D/dispatch timing histograms.  Rides into
+        # BENCH_r0N.json so every committed bench attributes its own
+        # wall-clock.
+        result["ingest_wait_frac"] = tele_report["ingest_wait_frac"]
+        result["telemetry"] = tele_report
     if ladder_rung is not None:
         result["ladder_rung"] = ladder_rung
     if ladder_errors:

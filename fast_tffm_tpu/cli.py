@@ -73,6 +73,18 @@ def build_argparser() -> argparse.ArgumentParser:
         help="byte budget for the epoch cache; overflowing falls back "
              "to re-parsing later epochs",
     )
+    # Observability knobs (override the cfg file).
+    p.add_argument(
+        "--heartbeat_secs", type=float, default=None,
+        help="emit a structured telemetry heartbeat (JSONL record into "
+             "metrics_file + one-line log summary with ingest_wait_frac) "
+             "every N seconds (0 = off)",
+    )
+    p.add_argument(
+        "--no_telemetry", action="store_true",
+        help="disable the run-wide telemetry layer entirely (no-op "
+             "instruments; heartbeats report nothing)",
+    )
     # Legacy reference flags (mapped, SURVEY.md §3.2).
     p.add_argument("--ps_hosts", default=None, help="legacy; ps tasks exit")
     p.add_argument("--worker_hosts", default=None,
@@ -118,9 +130,12 @@ def main(argv=None) -> int:
     overrides = {
         key: getattr(args, key)
         for key in ("steps_per_dispatch", "prefetch_super_batches",
-                    "parse_processes", "cache_epochs", "cache_max_bytes")
+                    "parse_processes", "cache_epochs", "cache_max_bytes",
+                    "heartbeat_secs")
         if getattr(args, key) is not None
     }
+    if args.no_telemetry:
+        overrides["telemetry"] = False
     cfg = load_config(args.cfg, overrides or None)
     _setup_logging(cfg.log_file or None)
     dist = _resolve_dist(args)

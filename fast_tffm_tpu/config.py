@@ -118,8 +118,21 @@ class FmConfig:
     profile_start_step: int = 10
     profile_steps: int = 5
     # JSONL stream of per-interval training metrics (step, examples,
-    # loss, auc, examples_per_sec, elapsed). Empty = off.
+    # loss, auc, examples_per_sec, elapsed). Empty = off.  Every record
+    # carries a "record" type ("run_header" | "train" | "validation" |
+    # "heartbeat" | "final") so one file is self-describing.
     metrics_file: str = ""
+    # Run-wide telemetry (obs.Telemetry): per-stage counters/gauges/
+    # timing histograms across reader, parse workers, the transfer
+    # thread, and the dispatch loop.  Near-zero hot-path overhead (one
+    # perf_counter + one uncontended lock per BATCH event); disabling it
+    # swaps in no-op instruments — zero behavior change either way.
+    telemetry: bool = True
+    # Heartbeat cadence in seconds: a background thread periodically
+    # writes one structured JSONL record (into metrics_file when set)
+    # with the telemetry snapshot + ingest_wait_frac, and logs a
+    # one-line summary — any run self-reports its bottleneck.  0 = off.
+    heartbeat_secs: float = 0.0
 
     # --- [Tpu] (new; not in reference) ---
     # Max features per example; batches are padded to this static shape.
@@ -222,6 +235,10 @@ class FmConfig:
             raise ValueError(
                 f"parse_processes must be >= 0, got {self.parse_processes}"
             )
+        if self.heartbeat_secs < 0:
+            raise ValueError(
+                f"heartbeat_secs must be >= 0, got {self.heartbeat_secs}"
+            )
         if self.cache_max_bytes <= 0:
             raise ValueError(
                 f"cache_max_bytes must be positive, got {self.cache_max_bytes}"
@@ -298,6 +315,8 @@ _KEYMAP = {
     "profile_start_step": ("profile_start_step", int),
     "profile_steps": ("profile_steps", int),
     "metrics_file": ("metrics_file", str),
+    "telemetry": ("telemetry", _parse_bool),
+    "heartbeat_secs": ("heartbeat_secs", float),
     "max_features": ("max_features", int),
     "mesh_data": ("mesh_data", int),
     "mesh_model": ("mesh_model", int),

@@ -25,11 +25,13 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 from collections import deque
 from typing import Iterator, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from fast_tffm_tpu import obs
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data import libsvm
 
@@ -106,6 +108,11 @@ class _ClosableQueue:
             self._cancelled = True
             self._items.clear()
             self._cv.notify_all()
+
+    def qsize(self) -> int:
+        """Instantaneous depth (snapshot-time telemetry sample; a racy
+        read of a deque length is exact enough for a gauge)."""
+        return len(self._items)
 
 
 def _read_weight_file(path: str) -> list[str]:
@@ -347,8 +354,28 @@ class BatchPipeline:
         cache_epochs: bool = False,
         cache_max_bytes: int = 1 << 30,
         epoch_marks: bool = False,
+        telemetry: Optional[obs.Telemetry] = None,
     ):
         self.files = list(files)
+        # Telemetry instruments (obs.NULL when not passed: every call
+        # below is a no-op, so instrumentation never branches).  Stage
+        # naming: ingest.* covers reader + parse workers + delivery.
+        self.telemetry = telemetry if telemetry is not None else obs.NULL
+        tel = self.telemetry
+        self._c_batches = tel.counter("ingest.batches")
+        self._c_examples = tel.counter("ingest.examples")
+        self._c_cache_replays = tel.counter("ingest.cache_replay_batches")
+        self._t_parse = tel.timer("ingest.parse")
+        self._t_reader_block = tel.timer("ingest.reader_block")
+        self._t_out_block = tel.timer("ingest.out_block")
+        # Always-real counter (not gated on telemetry): out-of-range-id
+        # batches are a data/vocabulary integrity signal the trainer
+        # surfaces in its RESULTS, not just in logs or optional stages.
+        self._oor_counter = obs.Counter()
+        tel.sample("ingest.oor_batches", lambda: self._oor_counter.value)
+        tel.sample(
+            "ingest.truncated_features", lambda: self.truncated_features
+        )
         self.cfg = cfg
         self.weight_files = list(weight_files) if weight_files else None
         self.epochs = epochs
@@ -430,12 +457,34 @@ class BatchPipeline:
         base = self._native.truncated_features if self._native else 0
         return base + self._trunc_extra
 
+    @property
+    def oor_batches(self) -> int:
+        """Batches whose host sort prep hit out-of-range feature ids — a
+        data/vocabulary_size integrity bug (the device-sort path silently
+        drops those updates).  Counted across thread AND process workers;
+        the trainer surfaces it in train results and the final record."""
+        return self._oor_counter.value
+
     def __iter__(self) -> Iterator:
         E, e0 = self.epochs, self.start_epoch
         if not self._cache_epochs:
-            yield from self._emit_stream(E - e0, e0, self.skip_batches)
-            return
-        yield from self._iter_cached(E, e0)
+            inner = self._emit_stream(E - e0, e0, self.skip_batches)
+        else:
+            inner = self._iter_cached(E, e0)
+        # Delivery accounting happens at the single exit point so every
+        # path (threads, procpool, cached replay) counts identically.
+        # The O(batch) example count only runs when telemetry is live —
+        # "disabled" must mean no per-batch work at all, or the bench's
+        # on/off overhead probe compares against a lie.
+        counting = self.telemetry.enabled
+        for item in inner:
+            if not isinstance(item, EpochEnd):
+                self._c_batches.add(1)
+                if counting:
+                    self._c_examples.add(
+                        int(np.count_nonzero(item.weights > 0))
+                    )
+            yield item
 
     def _emit_stream(self, n_epochs: int, first_epoch: int, skip: int):
         """_iter_stream with EpochEnd markers filtered per epoch_marks."""
@@ -501,6 +550,7 @@ class BatchPipeline:
                 random.Random(self.seed + epoch).shuffle(order)
             start = skip if epoch == e0 else 0
             for i in order[start:]:
+                self._c_cache_replays.add(1)
                 yield cache[i]
             # A re-parse of this epoch would have dropped the same
             # features again; keep the running counter truthful.
@@ -614,6 +664,7 @@ class BatchPipeline:
                 )
             )
         except _native.OutOfRangeIdsError as e:
+            self._oor_counter.add(1)
             log.warning(
                 "host sort_meta rejected a batch (%s); the input data or "
                 "vocabulary_size is wrong — the device-sort path will "
@@ -637,13 +688,24 @@ class BatchPipeline:
         work = _ClosableQueue(max(2, cfg.queue_size))
         out = _ClosableQueue(max(2, cfg.queue_size))
         n_workers = max(1, cfg.thread_num)
+        # Queue-depth gauges, sampled when a snapshot is taken (heartbeat
+        # cadence).  work deep + out shallow = parse-bound; work shallow
+        # + out deep = the consumer (training) is the bottleneck.
+        self.telemetry.sample("ingest.work_q_depth", work.qsize)
+        self.telemetry.sample("ingest.out_q_depth", out.qsize)
 
         def reader():
             try:
                 for seq, item in self._epoch_items(
                     n_epochs, first_epoch, skip
                 ):
-                    if not work.put((seq, item)):
+                    # Producer-block time: how long the reader waits for
+                    # a work-queue slot.  Large totals mean parsing (not
+                    # reading) limits ingest.
+                    t0 = time.perf_counter()
+                    ok = work.put((seq, item))
+                    self._t_reader_block.observe(time.perf_counter() - t0)
+                    if not ok:
                         return
             except BaseException as e:  # surfaces in the consumer
                 out.put(_Error(e))
@@ -665,20 +727,25 @@ class BatchPipeline:
                     out.put((seq, chunk))
                     continue
                 try:
-                    if isinstance(chunk, tuple):  # raw (buf, starts, ends)
-                        batch = self._native.parse_raw(
-                            chunk[0], chunk[1], chunk[2], cfg.batch_size
-                        )
-                    else:
-                        lines = [c[0] for c in chunk]
-                        weights = [c[1] for c in chunk]
-                        batch = self._parser(lines, weights)
-                    if self._sort_meta_spec is not None:
-                        batch = self._attach_meta(batch)
+                    with self._t_parse.time():
+                        if isinstance(chunk, tuple):  # raw (buf,starts,ends)
+                            batch = self._native.parse_raw(
+                                chunk[0], chunk[1], chunk[2], cfg.batch_size
+                            )
+                        else:
+                            lines = [c[0] for c in chunk]
+                            weights = [c[1] for c in chunk]
+                            batch = self._parser(lines, weights)
+                        if self._sort_meta_spec is not None:
+                            batch = self._attach_meta(batch)
                 except BaseException as e:
                     out.put(_Error(e))
                     continue
+                # Worker-block time on delivery: the consumer (transfer
+                # stage / training) isn't draining fast enough.
+                t0 = time.perf_counter()
                 out.put((seq, batch))
+                self._t_out_block.observe(time.perf_counter() - t0)
 
         threads = [threading.Thread(target=reader, daemon=True)]
         threads += [
@@ -763,6 +830,10 @@ class BatchPipeline:
         ]
         for p in procs:
             p.start()
+        # mp.Queue.qsize is approximate (and unimplemented on some
+        # platforms — snapshot() degrades a raising sample to -1).
+        self.telemetry.sample("ingest.work_q_depth", work.qsize)
+        self.telemetry.sample("ingest.out_q_depth", out.qsize)
 
         def put_mp(q, item) -> bool:
             return procpool.put_with_stop(q, item, stop)
@@ -772,13 +843,21 @@ class BatchPipeline:
         def reader():
             pend = None  # (buf, seq0, [starts...], [ends...])
 
+            def put_work(msg) -> bool:
+                # Same producer-block accounting as the thread path: time
+                # waiting for a work-queue slot (parse-bound signal).
+                t0 = time.perf_counter()
+                ok = put_mp(work, msg)
+                self._t_reader_block.observe(time.perf_counter() - t0)
+                return ok
+
             def flush() -> bool:
                 nonlocal pend
                 if pend is None:
                     return True
                 msg = ("raw", pend[1], pend[0], pend[2], pend[3])
                 pend = None
-                return put_mp(work, msg)
+                return put_work(msg)
 
             try:
                 for seq, item in self._epoch_items(
@@ -787,7 +866,7 @@ class BatchPipeline:
                     if isinstance(item, EpochEnd):
                         if not flush():
                             return
-                        if not put_mp(work, ("mark", seq, item.epoch)):
+                        if not put_work(("mark", seq, item.epoch)):
                             return
                     elif isinstance(item, tuple):  # raw group
                         buf, s, e = item
@@ -804,9 +883,7 @@ class BatchPipeline:
                             return
                         lines = [c[0] for c in item]
                         weights = [c[1] for c in item]
-                        if not put_mp(
-                            work, ("lines", seq, lines, weights)
-                        ):
+                        if not put_work(("lines", seq, lines, weights)):
                             return
                 if not flush():
                     return
@@ -845,11 +922,14 @@ class BatchPipeline:
                     raise msg[1]
                 if kind == "mark":
                     seq, obj = msg[1], EpochEnd(msg[2])
-                else:  # ("batch", seq, shm_name, has_meta, trunc, note)
+                else:  # ("batch", seq, shm, has_meta, trunc, note, parse_s)
                     seq = msg[1]
                     obj = procpool.attach_batch(spec, msg[2], msg[3])
                     self._trunc_extra += msg[4]
                     self._log_worker_note(msg[5])
+                    # Workers can't reach this process's registry; they
+                    # ship their parse wall time with each batch instead.
+                    self._t_parse.observe(msg[6])
                 if not self.ordered:
                     yield obj
                     continue
@@ -895,6 +975,7 @@ class BatchPipeline:
             return
         kind, msg = note
         if kind == "oor":
+            self._oor_counter.add(1)
             log.warning(
                 "host sort_meta rejected a batch (%s); the input data or "
                 "vocabulary_size is wrong — the device-sort path will "
@@ -969,10 +1050,19 @@ class DevicePrefetcher:
     """
 
     def __init__(self, source, steps_per_dispatch: int, put_fn,
-                 depth: int = 2):
+                 depth: int = 2, telemetry: Optional[obs.Telemetry] = None):
         self._k = max(1, steps_per_dispatch)
         self._put_fn = put_fn
         self._out = _ClosableQueue(max(1, depth))
+        # Transfer-stage instruments: stack vs H2D vs output-block time.
+        # out_block large = the device is the bottleneck (healthy);
+        # out_q_depth ~0 with the trainer starving = ingest-bound.
+        tel = telemetry if telemetry is not None else obs.NULL
+        self._t_stack = tel.timer("prefetch.stack")
+        self._t_put = tel.timer("prefetch.device_put")
+        self._t_out_block = tel.timer("prefetch.out_block")
+        self._c_super = tel.counter("prefetch.super_batches")
+        tel.sample("prefetch.out_q_depth", self._out.qsize)
         self._thread = threading.Thread(
             target=self._run, args=(iter(source),), daemon=True
         )
@@ -1014,8 +1104,15 @@ class DevicePrefetcher:
                     pass
 
     def _emit(self, group) -> bool:
-        dev = self._put_fn(stack_batches(group))
-        return self._out.put((dev, len(group)))
+        with self._t_stack.time(), obs.trace_span("tffm:stack"):
+            stacked = stack_batches(group)
+        with self._t_put.time(), obs.trace_span("tffm:h2d"):
+            dev = self._put_fn(stacked)
+        self._c_super.add(1)
+        t0 = time.perf_counter()
+        ok = self._out.put((dev, len(group)))
+        self._t_out_block.observe(time.perf_counter() - t0)
+        return ok
 
     def __iter__(self):
         try:

@@ -33,6 +33,7 @@ import dataclasses
 import os
 import pickle
 import queue as _queue
+import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Optional
 
@@ -285,8 +286,13 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop) -> None:
       None                                          — shutdown sentinel.
 
     Result messages:
-      ("batch", seq, shm_name, has_meta, trunc_delta, note)
+      ("batch", seq, shm_name, has_meta, trunc_delta, note, parse_s)
       ("mark", seq, epoch) | ("err", exc) | ("done",)
+
+    ``parse_s`` is this batch's parse+prep wall time in the worker — a
+    spawned process cannot write to the parent's telemetry registry, so
+    the duration rides the result message and the parent observes it
+    into the shared ``ingest.parse`` timer.
     """
     parse_lines, parse_raw, trunc = _build_parser(spec)
     meta_spec = spec.sort_meta_spec
@@ -294,13 +300,15 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop) -> None:
     def put(msg) -> bool:
         return put_with_stop(out, msg, stop)
 
-    def emit(batch: Batch, seq: int, trunc_delta: int) -> bool:
+    def emit(batch: Batch, seq: int, trunc_delta: int,
+             parse_s: float) -> bool:
         nonlocal meta_spec
         note = None
         has_meta = False
         if meta_spec is not None:
             from fast_tffm_tpu.data import native
 
+            t0 = time.perf_counter()
             try:
                 batch = batch._replace(
                     sort_meta=native.sort_meta(batch.ids, *meta_spec)
@@ -311,8 +319,11 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop) -> None:
             except Exception as e:
                 meta_spec = None  # this worker degrades for good
                 note = ("meta_failed", f"{type(e).__name__}: {e}")
+            # sort prep is parse-stage work; fold it into the shipped time
+            parse_s += time.perf_counter() - t0
         shm_name = ship_batch(spec, batch, has_meta)
-        if put(("batch", seq, shm_name, has_meta, trunc_delta, note)):
+        if put(("batch", seq, shm_name, has_meta, trunc_delta, note,
+                parse_s)):
             return True
         # Teardown raced the ship: the segment is already unregistered
         # from this worker's tracker and nobody will ever attach it —
@@ -338,14 +349,18 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop) -> None:
                 _, seq0, buf, starts_list, ends_list = msg
                 for j, (s, e) in enumerate(zip(starts_list, ends_list)):
                     before = trunc()
+                    t0 = time.perf_counter()
                     batch = parse_raw(buf, s, e)
-                    if not emit(batch, seq0 + j, trunc() - before):
+                    dt = time.perf_counter() - t0
+                    if not emit(batch, seq0 + j, trunc() - before, dt):
                         return
             else:  # lines
                 _, seq, lines, weights = msg
                 before = trunc()
+                t0 = time.perf_counter()
                 batch = parse_lines(lines, weights)
-                if not emit(batch, seq, trunc() - before):
+                dt = time.perf_counter() - t0
+                if not emit(batch, seq, trunc() - before, dt):
                     return
         except BaseException as e:
             if not put(("err", _safe_exc(e))):

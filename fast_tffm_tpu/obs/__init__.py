@@ -1,0 +1,20 @@
+"""Observability layer: run-wide telemetry + structured heartbeat.
+
+``obs.Telemetry`` is the shared instrument registry (counters, gauges,
+ring-buffer timings) every pipeline stage writes into; ``obs.NULL`` is
+the always-safe disabled registry; ``obs.trace_span`` names host phases
+in xprof traces; ``obs.Heartbeat``/``obs.JsonlWriter`` turn a running
+train into a self-reporting JSONL stream.  See telemetry.py for the
+design constraints (thread-safety, near-zero hot-path overhead, no jax
+or numpy imports).
+"""
+
+from fast_tffm_tpu.obs.heartbeat import Heartbeat, JsonlWriter
+from fast_tffm_tpu.obs.telemetry import (
+    NULL, Counter, Gauge, Telemetry, Timing, trace_span,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Timing", "Telemetry", "NULL", "trace_span",
+    "Heartbeat", "JsonlWriter",
+]

@@ -1,0 +1,316 @@
+"""Run-wide telemetry core: counters, gauges, ring-buffer timings.
+
+The trainer's stages (reader/parsers, the stacking/H2D transfer thread,
+the dispatch loop) live on different threads — and, with
+``parse_processes``, different processes — so the only way to attribute a
+run's wall-clock is a shared, thread-safe registry every stage writes
+into.  This module is that registry:
+
+- :class:`Counter` — monotonic totals (batches parsed, examples
+  delivered, cache replays, out-of-range batches);
+- :class:`Gauge` — last-value instruments, plus snapshot-time *samples*
+  (callables evaluated when a snapshot is taken: queue depths);
+- :class:`Timing` — a lock-guarded ring of recent durations with
+  monotonic count/total, reporting p50/p95/max over the window (the
+  fixed ring bounds memory for million-step runs; totals stay exact).
+
+Everything hangs off a :class:`Telemetry` instance.  A disabled instance
+(``Telemetry(enabled=False)``, or the module-level :data:`NULL`) hands
+out shared no-op instruments, so instrumented code calls them
+unconditionally — no ``if telemetry:`` branches in hot paths, and
+disabling telemetry is behaviorally invisible.
+
+Enabled overhead per event is one ``perf_counter`` call plus one
+uncontended lock acquire (~100 ns); events fire per *batch* (~thousands
+of examples), not per example, so the hot-path cost is noise-level —
+``bench.py`` measures the on/off e2e ratio to keep that claim honest.
+
+This module deliberately imports neither jax nor numpy: the data layer
+uses it, and spawned parse workers must stay jax-free.
+:func:`trace_span` resolves ``jax.profiler.TraceAnnotation`` lazily and
+degrades to a null context manager when jax is absent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "Counter", "Gauge", "Timing", "Telemetry", "NULL", "trace_span",
+]
+
+_RING = 512  # recent-window size for percentile estimates
+
+
+class Counter:
+    """Thread-safe monotonic counter."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Thread-safe last-value instrument."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class _TimingScope:
+    """Context manager recording its own wall time into a Timing."""
+
+    __slots__ = ("_timing", "_t0")
+
+    def __init__(self, timing: "Timing") -> None:
+        self._timing = timing
+
+    def __enter__(self) -> "_TimingScope":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._timing.observe(time.perf_counter() - self._t0)
+
+
+class Timing:
+    """Duration histogram: monotonic count/total + a ring of recent
+    observations for p50/p95/max.
+
+    The ring holds the last :data:`_RING` durations — percentiles
+    describe *recent* behavior (what a heartbeat wants: "is the parse
+    slowing down NOW"), while ``count``/``total_s`` stay exact over the
+    whole run so rates and wall-clock attribution never drift.
+    """
+
+    __slots__ = ("_lock", "_ring", "_idx", "_count", "_total")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ring: list = [0.0] * _RING
+        self._idx = 0
+        self._count = 0
+        self._total = 0.0
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._ring[self._idx % _RING] = seconds
+            self._idx += 1
+            self._count += 1
+            self._total += seconds
+
+    def time(self) -> _TimingScope:
+        """``with timing.time(): ...`` records the block's wall time."""
+        return _TimingScope(self)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total_s(self) -> float:
+        return self._total
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            n = min(self._count, _RING)
+            window = sorted(self._ring[:n])
+            count, total = self._count, self._total
+        if not count:
+            return {"count": 0, "total_s": 0.0}
+        # p50/p95/max all describe the recent window (a cold-start
+        # outlier ages out of max_ms once the ring turns over);
+        # count/total_s are run-exact.
+        p50 = window[int(0.50 * (n - 1))] if n else 0.0
+        p95 = window[int(0.95 * (n - 1))] if n else 0.0
+        return {
+            "count": count,
+            "total_s": round(total, 6),
+            "mean_ms": round(1e3 * total / count, 4),
+            "p50_ms": round(1e3 * p50, 4),
+            "p95_ms": round(1e3 * p95, 4),
+            "max_ms": round(1e3 * window[-1], 4) if n else 0.0,
+        }
+
+
+class _NullCounter:
+    __slots__ = ()
+
+    def add(self, n: int = 1) -> None:
+        pass
+
+    value = 0
+
+
+class _NullGauge:
+    __slots__ = ()
+
+    def set(self, v: float) -> None:
+        pass
+
+    value = 0.0
+
+
+class _NullTiming:
+    __slots__ = ()
+    count = 0
+    total_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        pass
+
+    def time(self):
+        return _NULL_CTX
+
+    def snapshot(self) -> dict:
+        return {"count": 0, "total_s": 0.0}
+
+
+_NULL_CTX = contextlib.nullcontext()
+_NULL_COUNTER = _NullCounter()
+_NULL_GAUGE = _NullGauge()
+_NULL_TIMING = _NullTiming()
+
+
+class Telemetry:
+    """Named-instrument registry shared across a run's stages.
+
+    ``counter/gauge/timer`` create-or-return by dotted name (idempotent,
+    thread-safe), so independent components — pipeline, prefetcher,
+    trainer, bench — agree on instruments without passing them around.
+    A disabled registry hands out shared no-op instruments and snapshots
+    to ``{}``; callers never branch on ``enabled``.
+    """
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timers: Dict[str, Timing] = {}
+        self._samples: Dict[str, Callable[[], float]] = {}
+
+    def counter(self, name: str) -> Counter:
+        if not self.enabled:
+            return _NULL_COUNTER  # type: ignore[return-value]
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        if not self.enabled:
+            return _NULL_GAUGE  # type: ignore[return-value]
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def timer(self, name: str) -> Timing:
+        if not self.enabled:
+            return _NULL_TIMING  # type: ignore[return-value]
+        with self._lock:
+            return self._timers.setdefault(name, Timing())
+
+    def reset(self) -> None:
+        """Drop every instrument, sample, and accumulated value IN
+        PLACE: references to the registry itself stay live (and future
+        ``counter()``/``sample()`` calls re-create instruments), but
+        previously handed-out instrument handles are orphaned.  The
+        trainer resets at the top of each train() so a second run never
+        reports run-1 + run-2 totals against run 2's wall clock."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+            self._samples.clear()
+
+    def sample(self, name: str, fn: Callable[[], float]) -> None:
+        """Register (or replace) a snapshot-time sample — e.g. a queue's
+        ``qsize``.  Evaluated lazily at :meth:`snapshot`; exceptions
+        degrade to -1 (an mp.Queue's qsize can be unimplemented, and a
+        sampled object may already be torn down)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._samples[name] = fn
+
+    def snapshot(self) -> dict:
+        """One nested dict of everything: counters, gauges (stored values
+        and live samples), timer histograms.  Safe to call from any
+        thread at any time, including after the run's stages shut down."""
+        if not self.enabled:
+            return {}
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timers = dict(self._timers)
+            samples = dict(self._samples)
+        out: dict = {
+            "counters": {k: c.value for k, c in counters.items()},
+            "gauges": {k: g.value for k, g in gauges.items()},
+            "timers": {k: t.snapshot() for k, t in timers.items()},
+        }
+        for name, fn in samples.items():
+            try:
+                out["gauges"][name] = fn()
+            except Exception:  # pragma: no cover - torn-down sampled object
+                out["gauges"][name] = -1
+        return out
+
+
+NULL = Telemetry(enabled=False)
+
+_trace_annotation: Optional[Callable] = None
+_trace_resolved = False
+
+
+def trace_span(name: str):
+    """``jax.profiler.TraceAnnotation(name)`` when jax is importable,
+    else a null context manager.
+
+    Makes xprof traces readable — stack/H2D/dispatch phases show up as
+    named host spans — without making the data layer depend on jax (the
+    spawned parse workers must never import it).  The annotation only
+    resolves once jax is ALREADY imported by someone else: triggering a
+    jax import from here would dial this machine's remote-TPU tunnel
+    from jax-free tools (ingest_bench), and with no jax there is no
+    trace to annotate anyway.  With no active trace an annotation is
+    nearly free.
+    """
+    global _trace_annotation, _trace_resolved
+    if not _trace_resolved:
+        import sys as _sys
+
+        if "jax" not in _sys.modules:
+            return contextlib.nullcontext()
+        _trace_resolved = True
+        try:  # pragma: no cover - env-dependent
+            import jax.profiler as _prof
+
+            _trace_annotation = _prof.TraceAnnotation
+        except Exception:
+            _trace_annotation = None
+    if _trace_annotation is None:
+        return contextlib.nullcontext()
+    return _trace_annotation(name)

@@ -21,6 +21,8 @@ K-step granularity; a resume always lands on a super-batch boundary.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import json
 import logging
 import time
@@ -32,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from fast_tffm_tpu import obs
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.libsvm import Batch
 from fast_tffm_tpu.data.pipeline import (
@@ -212,6 +215,15 @@ def _finalize_metrics(ms: MetricState, loss_type: str = "logistic") -> dict:
     return out
 
 
+def _config_fingerprint(cfg: FmConfig) -> str:
+    """Short stable hash of the FULL config — the run-header record's
+    identity, so two metrics files are comparable iff fingerprints match
+    (unlike Trainer._data_fingerprint, which names only the input
+    stream)."""
+    blob = json.dumps(dataclasses.asdict(cfg), sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
 def _params_template(cfg: FmConfig, param_sh):
     shapes = jax.eval_shape(partial(fm.init_params, cfg=cfg), jax.random.PRNGKey(0))
     return jax.tree.map(
@@ -233,6 +245,10 @@ class Trainer:
     def __init__(self, cfg: FmConfig, mesh=None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
+        # Run-wide telemetry registry, shared by the ingest pipeline, the
+        # transfer thread, and the dispatch loop.  Disabled -> every
+        # instrument is a shared no-op (zero behavior change).
+        self.telemetry = obs.Telemetry(enabled=cfg.telemetry)
         # Input-pipeline position for checkpointed mid-epoch resume.
         self._epoch = 0
         self._batches_done = 0
@@ -529,7 +545,7 @@ class Trainer:
                     resume_epoch, resume_skip,
                 )
         metrics_out = (
-            open(cfg.metrics_file, "a") if cfg.metrics_file else None
+            obs.JsonlWriter(cfg.metrics_file) if cfg.metrics_file else None
         )
         pipe_cfg, shard, _ = self._input_plan()
         profiling = False
@@ -537,8 +553,54 @@ class Trainer:
         profile_stop_at = 0
         k = cfg.steps_per_dispatch
         t0 = time.time()
-        last_log_t, last_log_ex = t0, 0.0
+        # A self-describing stream starts with its run identity: one
+        # header record carries the config fingerprint, dispatch/ingest
+        # mode, and platform versions, so any metrics file can be read
+        # without the .cfg that produced it.
+        if metrics_out is not None:
+            metrics_out.write({
+                "record": "run_header",
+                "time": t0,
+                "config_fingerprint": _config_fingerprint(cfg),
+                "steps_per_dispatch": k,
+                "ingest_mode": (
+                    "procs" if cfg.parse_processes > 0 else "threads"
+                ),
+                "fast_ingest": cfg.fast_ingest,
+                "cache_epochs": cfg.cache_epochs,
+                "batch_size": cfg.batch_size,
+                "epoch_num": cfg.epoch_num,
+                "optimizer": cfg.optimizer,
+                "telemetry": cfg.telemetry,
+                "heartbeat_secs": cfg.heartbeat_secs,
+                "jax_version": jax.__version__,
+                "backend": jax.default_backend(),
+                "mesh": {str(a): int(n) for a, n in self.mesh.shape.items()},
+                "n_processes": jax.process_count(),
+                "resume_step": self._restored_step,
+                "resume_epoch": resume_epoch,
+                "resume_skip": resume_skip,
+            })
+        # Seed the step-rate interval from the CURRENT metric state, not
+        # 0: a warm-started Trainer (or a second train() on the same
+        # instance) carries pre-resume examples in metrics.count, and the
+        # first ex/s interval used to be inflated by all of them.
+        last_log_t = t0
+        last_log_ex = float(self.state.metrics.count)
         stepno = 0
+        # Per-run accounting: instruments persisted across runs would
+        # report run-1+run-2 totals against run 2's wall clock
+        # (ingest_wait_frac > 1 on a second train() of a warm Trainer).
+        # Reset IN PLACE so external references to trainer.telemetry
+        # stay live.
+        self.telemetry.reset()
+        # Starvation-vs-dispatch split: wait_input times next() on the
+        # prefetcher (the loop is input-starved), dispatch times the
+        # fused-scan call (includes any device backpressure block); wall
+        # minus the two is "other" (logging/validation/save).  The
+        # heartbeat derives ingest_wait_frac = wait / wall from these.
+        t_wait = self.telemetry.timer("train.wait_input")
+        t_disp = self.telemetry.timer("train.dispatch")
         # Cadences move to super-batch (K-step) granularity: a trigger
         # fires at the first dispatch boundary where at least its period
         # of NEW steps has elapsed since it last fired.  At K == 1 this
@@ -578,6 +640,7 @@ class Trainer:
             cache_epochs=cfg.cache_epochs,
             cache_max_bytes=cfg.cache_max_bytes,
             epoch_marks=True,
+            telemetry=self.telemetry,
         )
         # Transfer stage: a background thread stacks K parsed batches
         # and ships super-batch n+1 (shard + device_put) while n trains;
@@ -589,11 +652,52 @@ class Trainer:
         prefetcher = DevicePrefetcher(
             pipeline, k, self._put_super,
             depth=cfg.prefetch_super_batches,
+            telemetry=self.telemetry,
         )
         cache_logged = not cfg.cache_epochs
+
+        def telemetry_record(kind: str) -> dict:
+            """One structured self-report (heartbeat/final), host-side
+            only: counters/gauges/timers — never a device readback, which
+            would force a sync from the heartbeat thread mid-dispatch."""
+            now = time.time()
+            wall = max(now - t0, 1e-9)
+            wait_s, disp_s = t_wait.total_s, t_disp.total_s
+            return {
+                "record": kind,
+                "time": now,
+                "step": stepno,
+                "epoch": self._epoch,
+                "elapsed": round(wall, 3),
+                "examples_in": self.telemetry.counter(
+                    "ingest.examples"
+                ).value,
+                "wait_input_s": round(wait_s, 3),
+                "dispatch_s": round(disp_s, 3),
+                "other_s": round(max(0.0, wall - wait_s - disp_s), 3),
+                "ingest_wait_frac": round(wait_s / wall, 4),
+                "truncated_features": int(pipeline.truncated_features),
+                "out_of_range_batches": int(pipeline.oor_batches),
+                "ingest_cache": pipeline.cache_result,
+                "stages": self.telemetry.snapshot(),
+            }
+
+        heartbeat = None
+        if cfg.heartbeat_secs > 0:
+            heartbeat = obs.Heartbeat(
+                cfg.heartbeat_secs, partial(telemetry_record, "heartbeat"),
+                writer=metrics_out,
+            )
         try:
             try:
-                for item in prefetcher:
+                source = iter(prefetcher)
+                while True:
+                    # Starvation accounting: time blocked waiting for the
+                    # next staged super-batch.
+                    with t_wait.time():
+                        item = next(source, None)
+                    if item is None:
+                        break
                     if isinstance(item, EpochEnd):
                         self._epoch = item.epoch + 1
                         self._batches_done = 0
@@ -616,9 +720,13 @@ class Trainer:
                         profiling = profile_started = True
                         profile_stop_at = stepno + cfg.profile_steps
                     # ONE dispatch = kk fused train steps (lax.scan).
-                    self.state = self._scan_train_step(
-                        self.state, super_batch
-                    )
+                    # The dispatch is async: this wall time is enqueue
+                    # cost plus any device backpressure block — the
+                    # compute-bound half of the wall-clock split.
+                    with t_disp.time(), obs.trace_span("tffm:dispatch"):
+                        self.state = self._scan_train_step(
+                            self.state, super_batch
+                        )
                     stepno += kk
                     self._batches_done += kk
                     if profiling and stepno >= profile_stop_at:
@@ -667,15 +775,15 @@ class Trainer:
                             )
                             trunc_logged = cur_trunc
                         if metrics_out is not None:
-                            metrics_out.write(json.dumps({
+                            metrics_out.write({
+                                "record": "train",
                                 "step": stepno,
                                 "examples": m["examples"],
                                 "loss": m["loss"],
                                 "auc": m["auc"],
                                 "examples_per_sec": rate,
                                 "elapsed": now - t0,
-                            }) + "\n")
-                            metrics_out.flush()
+                            })
                     if (
                         cfg.validation_steps
                         and cfg.validation_files
@@ -688,12 +796,19 @@ class Trainer:
                             stepno, vm["loss"], vm["auc"],
                         )
                         if metrics_out is not None:
-                            metrics_out.write(json.dumps({
+                            # Same shape as train records (elapsed /
+                            # examples alongside the losses) so one file
+                            # plots both streams on one time axis.
+                            metrics_out.write({
+                                "record": "validation",
                                 "step": stepno,
+                                "examples": vm["examples"],
+                                "loss": vm["loss"],
+                                "auc": vm["auc"],
                                 "validation_loss": vm["loss"],
                                 "validation_auc": vm["auc"],
-                            }) + "\n")
-                            metrics_out.flush()
+                                "elapsed": time.time() - t0,
+                            })
                     if (
                         cfg.save_steps
                         and stepno - last_save_step >= cfg.save_steps
@@ -701,6 +816,8 @@ class Trainer:
                         last_save_step = stepno
                         self.save(stepno)
             finally:
+                if heartbeat is not None:
+                    heartbeat.close()
                 prefetcher.close()
             self._epoch = cfg.epoch_num
             self._batches_done = 0
@@ -710,6 +827,12 @@ class Trainer:
                     "%d feature occurrences dropped by max_features=%d "
                     "over the run", total_trunc, cfg.max_features,
                 )
+            # The stream's last word: one exact end-of-run self-report
+            # (the heartbeat's schema with record="final"), written even
+            # when periodic heartbeats are off.
+            self._final_record = telemetry_record("final")
+            if metrics_out is not None:
+                metrics_out.write(self._final_record)
         finally:
             # An abandoned trace poisons any later start_trace in-process.
             if profiling:
@@ -722,8 +845,18 @@ class Trainer:
         )
         train_metrics["steps"] = stepno
         # Cache observability rides the result too ("off" | "cached" |
-        # "overflow") so sweeps can tell which runs actually replayed.
+        # "overflow") so sweeps can tell which runs actually replayed,
+        # alongside the run's data-integrity counters (truncation and
+        # out-of-range-id batches used to be log-only) and the
+        # wall-clock split the telemetry layer measured.
         train_metrics["ingest_cache"] = pipeline.cache_result
+        train_metrics["truncated_features"] = int(total_trunc)
+        train_metrics["out_of_range_batches"] = int(pipeline.oor_batches)
+        train_metrics["ingest_wait_frac"] = (
+            self._final_record["ingest_wait_frac"]
+        )
+        train_metrics["wait_input_s"] = self._final_record["wait_input_s"]
+        train_metrics["dispatch_s"] = self._final_record["dispatch_s"]
         self.save(stepno)
         result = {"train": train_metrics}
         if cfg.validation_files:

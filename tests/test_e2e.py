@@ -278,7 +278,13 @@ def test_metrics_file_and_profiler(tmp_path, rng):
     Trainer(cfg).train()
     lines = open(tmp_path / "metrics.jsonl").read().splitlines()
     assert lines, "metrics stream empty"
-    rec = json.loads(lines[-1])
+    recs = [json.loads(line) for line in lines]
+    # Self-describing stream: header first, exact final report last.
+    assert recs[0]["record"] == "run_header"
+    assert recs[-1]["record"] == "final"
+    trains = [r for r in recs if r["record"] == "train"]
+    assert trains, "no train interval records"
+    rec = trains[-1]
     assert {"step", "examples", "loss", "auc", "examples_per_sec",
             "elapsed"} <= set(rec)
     assert rec["examples"] == 512

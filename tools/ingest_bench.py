@@ -10,6 +10,12 @@ chips):
   pipeline  — BatchPipeline end-to-end drain (reader + N parse workers +
               shuffle), the rate training actually sees
 
+Pipeline-stage records come from the pipeline's OWN telemetry snapshot
+(obs.Telemetry) rather than bench-local stopwatches: delivered-example
+counts exclude tail-batch padding, and each record carries the stage
+attribution a training heartbeat would report (parse total/percentiles,
+reader-block, worker delivery-block).
+
 Prints a JSON line per measurement; run with no args on any machine.
 Results are committed to INGEST.md with the host's core count — rates
 scale with cores since parse workers are independent.
@@ -30,6 +36,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 BATCH, NFEAT, VOCAB = 4096, 39, 1 << 20
+
+
+def drain_with_telemetry(pipe, tel) -> dict:
+    """Drain a BatchPipeline and report from ITS telemetry snapshot:
+    the examples counter (real lines, padding excluded) gives the rate;
+    the parse/reader_block/out_block timers attribute the drain's time
+    the same way a training run's heartbeat would."""
+    t0 = time.perf_counter()
+    for _b in pipe:
+        pass
+    dt = max(time.perf_counter() - t0, 1e-9)
+    snap = tel.snapshot()
+    timers = snap.get("timers", {})
+
+    def t(name, key):
+        return timers.get(name, {}).get(key, 0.0)
+
+    return {
+        "lines_per_sec": round(snap["counters"]["ingest.examples"] / dt),
+        "batches": snap["counters"]["ingest.batches"],
+        "parse_total_s": t("ingest.parse", "total_s"),
+        "parse_p50_ms": t("ingest.parse", "p50_ms"),
+        "parse_p95_ms": t("ingest.parse", "p95_ms"),
+        "reader_block_s": t("ingest.reader_block", "total_s"),
+        "worker_out_block_s": t("ingest.out_block", "total_s"),
+    }
 
 
 def _proc_worker(files, epochs, ready, go, out):
@@ -111,6 +143,7 @@ def bench_procs(files, n_procs: int, epochs: int = 2):
 
 def main() -> int:
     from bench import _gen_libsvm_files
+    from fast_tffm_tpu import obs
     from fast_tffm_tpu.config import FmConfig
     from fast_tffm_tpu.data import native as native_lib
     from fast_tffm_tpu.data.pipeline import BatchPipeline, _iter_raw_groups
@@ -173,16 +206,14 @@ def main() -> int:
                     vocabulary_size=VOCAB, factor_num=8, max_features=NFEAT,
                     batch_size=BATCH, thread_num=tn, queue_size=8,
                 )
+                tel = obs.Telemetry()
                 pipe = BatchPipeline(
                     files, cfg, epochs=2, shuffle=not ordered,
-                    ordered=ordered,
+                    ordered=ordered, telemetry=tel,
                 )
-                t0 = time.perf_counter()
-                n = 0
-                for _b in pipe:
-                    n += BATCH
-                emit("pipeline", n / (time.perf_counter() - t0),
-                     thread_num=tn, ordered=ordered)
+                stats = drain_with_telemetry(pipe, tel)
+                emit("pipeline", stats.pop("lines_per_sec"),
+                     thread_num=tn, ordered=ordered, **stats)
 
         # Process-parallel ingest: N fully independent reader+parser
         # processes over disjoint file shards (the multi-host input-
@@ -209,13 +240,13 @@ def main() -> int:
                 vocabulary_size=VOCAB, factor_num=8, max_features=NFEAT,
                 batch_size=BATCH, queue_size=8, parse_processes=np_,
             )
-            pipe = BatchPipeline(files, cfg, epochs=1, shuffle=True)
-            t0 = time.perf_counter()
-            n = 0
-            for _b in pipe:
-                n += BATCH
-            emit("pipeline-procpool", n / (time.perf_counter() - t0),
-                 parse_processes=np_, cores=os.cpu_count())
+            tel = obs.Telemetry()
+            pipe = BatchPipeline(
+                files, cfg, epochs=1, shuffle=True, telemetry=tel
+            )
+            stats = drain_with_telemetry(pipe, tel)
+            emit("pipeline-procpool", stats.pop("lines_per_sec"),
+                 parse_processes=np_, cores=os.cpu_count(), **stats)
 
         # Pipeline with per-batch sort_meta on the workers: what the
         # training path actually runs when host_sort engages.
@@ -224,18 +255,17 @@ def main() -> int:
                 vocabulary_size=VOCAB, factor_num=8, max_features=NFEAT,
                 batch_size=BATCH, thread_num=tn, queue_size=8,
             )
+            tel = obs.Telemetry()
             pipe = BatchPipeline(
                 files, cfg, epochs=2, shuffle=True,
                 sort_meta_spec=(
                     VOCAB, sparse_apply.CHUNK, sparse_apply.TILE
                 ),
+                telemetry=tel,
             )
-            t0 = time.perf_counter()
-            n = 0
-            for _b in pipe:
-                n += BATCH
-            emit("pipeline+meta", n / (time.perf_counter() - t0),
-                 thread_num=tn)
+            stats = drain_with_telemetry(pipe, tel)
+            emit("pipeline+meta", stats.pop("lines_per_sec"),
+                 thread_num=tn, **stats)
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return 0

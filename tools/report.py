@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Pretty-print / summarize a telemetry+metrics JSONL stream.
+
+The trainer's ``metrics_file`` is self-describing (every record carries
+a ``record`` type: run_header | train | validation | heartbeat | final);
+this tool turns one file into a human summary:
+
+  python tools/report.py /path/to/metrics.jsonl
+
+Sections: the run header (config fingerprint, dispatch/ingest mode,
+platform), the train/validation progression, and the end-of-run
+wall-clock attribution — starvation (``ingest_wait_frac``) vs dispatch
+vs other, per-stage timing histograms, queue-depth gauges, and the
+data-integrity counters (truncated features, out-of-range-id batches,
+cache outcome).  Records from pre-telemetry runs (no ``record`` field)
+are classified by their keys, so old files still summarize.
+
+Dependency-free on purpose: it must run on any box the JSONL lands on,
+jax or not.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _classify(rec: dict) -> str:
+    """Record type, inferring for legacy streams without `record`."""
+    kind = rec.get("record")
+    if kind:
+        return kind
+    if "validation_loss" in rec:
+        return "validation"
+    if "loss" in rec:
+        return "train"
+    return "unknown"
+
+
+def load(path: str) -> dict:
+    """Group a JSONL file's records by type (order preserved)."""
+    groups: dict = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                print(f"  ! line {lineno}: not JSON, skipped",
+                      file=sys.stderr)
+                continue
+            groups.setdefault(_classify(rec), []).append(rec)
+    return groups
+
+
+def _fmt_rate(v: float) -> str:
+    if v >= 1e6:
+        return f"{v / 1e6:.2f}M"
+    if v >= 1e3:
+        return f"{v / 1e3:.1f}k"
+    return f"{v:.0f}"
+
+
+def _print_header(header: dict) -> None:
+    print("run:")
+    for key in (
+        "config_fingerprint", "steps_per_dispatch", "ingest_mode",
+        "fast_ingest", "cache_epochs", "batch_size", "epoch_num",
+        "optimizer", "backend", "jax_version", "mesh", "telemetry",
+        "heartbeat_secs", "resume_step", "resume_epoch", "resume_skip",
+    ):
+        if key in header:
+            print(f"  {key:20s} {header[key]}")
+
+
+def _print_progress(trains: list, valids: list, limit: int) -> None:
+    if trains:
+        print(f"\ntrain records ({len(trains)}; showing last {limit}):")
+        print(f"  {'step':>8} {'examples':>12} {'loss':>9} {'auc':>7} "
+              f"{'ex/s':>9}")
+        for r in trains[-limit:]:
+            print(
+                f"  {r.get('step', 0):>8} {r.get('examples', 0):>12.0f} "
+                f"{r.get('loss', float('nan')):>9.5f} "
+                f"{r.get('auc', float('nan')):>7.4f} "
+                f"{_fmt_rate(r.get('examples_per_sec', 0.0)):>9}"
+            )
+    if valids:
+        print(f"\nvalidation records ({len(valids)}; showing last {limit}):")
+        for r in valids[-limit:]:
+            loss = r.get("validation_loss", r.get("loss", float("nan")))
+            auc = r.get("validation_auc", r.get("auc", float("nan")))
+            print(f"  step {r.get('step', '?'):>8}  loss {loss:.5f}  "
+                  f"auc {auc:.4f}")
+
+
+def _print_breakdown(rec: dict) -> None:
+    kind = rec.get("record", "final")
+    wall = max(rec.get("elapsed", 0.0), 1e-9)
+    wait = rec.get("wait_input_s", 0.0)
+    disp = rec.get("dispatch_s", 0.0)
+    other = rec.get("other_s", max(0.0, wall - wait - disp))
+    frac = rec.get("ingest_wait_frac", wait / wall)
+    print(f"\nwall-clock attribution ({kind} record, step "
+          f"{rec.get('step', '?')}, {wall:.1f}s):")
+    print(f"  waiting for input   {wait:>9.2f}s  ({100 * wait / wall:5.1f}%)"
+          f"   <- starvation: ingest too slow")
+    print(f"  dispatch            {disp:>9.2f}s  ({100 * disp / wall:5.1f}%)"
+          f"   <- enqueue + device backpressure")
+    print(f"  other               {other:>9.2f}s  "
+          f"({100 * other / wall:5.1f}%)   <- logging/validation/save")
+    verdict = (
+        "INGEST-BOUND (grow thread_num/parse_processes, or cache_epochs)"
+        if frac > 0.25 else "compute-bound (ingest keeps up)"
+    )
+    print(f"  ingest_wait_frac    {frac:>9.3f}    -> {verdict}")
+    for key in ("truncated_features", "out_of_range_batches",
+                "ingest_cache", "examples_in"):
+        if key in rec:
+            print(f"  {key:22s} {rec[key]}")
+    stages = rec.get("stages") or {}
+    timers = stages.get("timers") or {}
+    if timers:
+        print("\nstage timers:")
+        print(f"  {'stage':24} {'count':>8} {'total_s':>9} {'p50_ms':>8} "
+              f"{'p95_ms':>8} {'max_ms':>8}")
+        for name in sorted(timers):
+            t = timers[name]
+            print(
+                f"  {name:24} {t.get('count', 0):>8} "
+                f"{t.get('total_s', 0.0):>9.2f} {t.get('p50_ms', 0.0):>8.2f} "
+                f"{t.get('p95_ms', 0.0):>8.2f} {t.get('max_ms', 0.0):>8.2f}"
+            )
+    gauges = stages.get("gauges") or {}
+    if gauges:
+        print("\ngauges (at snapshot time):")
+        for name in sorted(gauges):
+            print(f"  {name:24} {gauges[name]}")
+    counters = stages.get("counters") or {}
+    if counters:
+        print("\ncounters:")
+        for name in sorted(counters):
+            print(f"  {name:24} {counters[name]}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a fast_tffm_tpu metrics/telemetry JSONL"
+    )
+    ap.add_argument("path", help="metrics_file JSONL written by a run")
+    ap.add_argument("--limit", type=int, default=8,
+                    help="train/validation rows to show (default 8)")
+    args = ap.parse_args(argv)
+    groups = load(args.path)
+    if not groups:
+        print(f"{args.path}: no records")
+        return 1
+    headers = groups.get("run_header", [])
+    if headers:
+        _print_header(headers[-1])
+    _print_progress(
+        groups.get("train", []), groups.get("validation", []), args.limit
+    )
+    # The final record is the exact end-of-run report; fall back to the
+    # last heartbeat for a run that died mid-flight (that's the point of
+    # heartbeats: the stream still says where the time went).
+    final = groups.get("final") or groups.get("heartbeat")
+    if final:
+        _print_breakdown(final[-1])
+        hbs = groups.get("heartbeat", [])
+        if hbs:
+            print(f"\nheartbeats: {len(hbs)} "
+                  f"(last at elapsed {hbs[-1].get('elapsed', 0.0):.1f}s)")
+    else:
+        print("\nno heartbeat/final records (pre-telemetry stream or "
+              "heartbeat_secs=0 and the run died before the final record)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
