@@ -381,6 +381,10 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     pipeline = BatchPipeline(
         files, cfg, epochs=epochs, shuffle=True, ordered=True,
         cache_epochs=True, cache_max_bytes=4 << 30, epoch_marks=True,
+        # Pre-stacked cache: groups stack once at epoch-0 boundaries and
+        # replay epochs hand whole super-batches to the prefetcher (the
+        # trainer's cache_prestacked path).
+        prestack_k=k,
         telemetry=tel,
     )
 
@@ -394,6 +398,9 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
 
     prefetcher = DevicePrefetcher(
         pipeline, k, put, depth=cfg.prefetch_super_batches, telemetry=tel,
+        # put() device_puts (copies out of host memory), so stacking can
+        # recycle the pre-allocated staging buffers like the trainer.
+        staging=True,
     )
     it = iter(prefetcher)
     epoch_rates: dict[int, float] = {}
@@ -440,34 +447,68 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     cached = float(np.median(replays)) if replays else 0.0
     wait_s = t_wait.total_s - wait0
     disp_s = t_disp.total_s - disp0
+    snap = tel.snapshot()
     tele_report = {
         "ingest_wait_frac": round(wait_s / max(dt, 1e-9), 4),
         "wait_input_s": round(wait_s, 3),
         "dispatch_s": round(disp_s, 3),
         "timed_wall_s": round(dt, 3),
-        "stages": tel.snapshot(),
+        "stages": snap,
     }
+    # Prestacked-cache split: how many dispatches skipped the transfer-
+    # stage stack (epoch 0 stacks once in the pipeline; replays reuse),
+    # and the once-per-group stack cost wherever it was paid.
+    counters = snap.get("counters", {})
+    timers = snap.get("timers", {})
+    supers = counters.get("prefetch.super_batches", 0)
+    if supers:
+        tele_report["prestack_hit_frac"] = round(
+            counters.get("prefetch.prestack_hits", 0) / supers, 4
+        )
+    stack_n = (
+        timers.get("prefetch.stack", {}).get("count", 0)
+        + timers.get("ingest.prestack", {}).get("count", 0)
+    )
+    stack_s = (
+        timers.get("prefetch.stack", {}).get("total_s", 0.0)
+        + timers.get("ingest.prestack", {}).get("total_s", 0.0)
+    )
+    if stack_n:
+        tele_report["stack_ms_per_superbatch"] = round(
+            1e3 * stack_s / stack_n, 3
+        )
     return (
         (n / dt if dt > 0 else 0.0), pipeline.cache_result, epoch0, cached,
         tele_report,
     )
 
 
-def _bench_pipeline_ingest(files, cfg, parse_processes: int) -> float:
-    """Lines/sec draining the FULL BatchPipeline (reader + parse workers
-    + delivery) with no training attached — threads vs a process pool on
-    the same files is the parse_processes scaling comparison."""
+def _bench_pipeline_ingest(files, cfg, parse_processes: int
+                           ) -> tuple[float, float]:
+    """(lines/sec, ring_zero_copy_frac) draining the FULL BatchPipeline
+    (reader + parse workers + delivery) with no training attached —
+    threads vs a process pool on the same files is the parse_processes
+    scaling comparison, now running on the inbound SHM ring (the frac
+    reports how many raw windows went zero-copy vs pickled; -1 when the
+    mode has no ring, i.e. threads)."""
     import dataclasses
 
+    from fast_tffm_tpu import obs
     from fast_tffm_tpu.data.pipeline import BatchPipeline
 
     c = dataclasses.replace(cfg, parse_processes=parse_processes)
+    tel = obs.Telemetry()
     n = 0
     t0 = time.perf_counter()
-    for b in BatchPipeline(files, c, epochs=1, shuffle=False):
+    for b in BatchPipeline(files, c, epochs=1, shuffle=False,
+                           telemetry=tel):
         n += int(np.count_nonzero(b.weights))
     dt = time.perf_counter() - t0
-    return n / dt if dt > 0 else 0.0
+    counters = tel.snapshot().get("counters", {})
+    ring = counters.get("ingest.ring_windows", 0)
+    fallback = counters.get("ingest.ring_fallback_windows", 0)
+    frac = ring / (ring + fallback) if (ring + fallback) else -1.0
+    return (n / dt if dt > 0 else 0.0), frac
 
 
 def main() -> int:
@@ -510,6 +551,7 @@ def main() -> int:
     dispatch_overhead_ms, h2d_overlap_frac = 0.0, 0.0
     e2e_epoch0, e2e_cached = 0.0, 0.0
     ingest_threads_rate, ingest_procs_rate = 0.0, 0.0
+    ring_zero_copy_frac = -1.0
     bench_procs = 0
     ingest_cache = "off"
     tele_report = None
@@ -665,11 +707,11 @@ def main() -> int:
                     # the same files (no training attached).
                     try:
                         bench_procs = min(4, max(2, workers // 2))
-                        ingest_threads_rate = _bench_pipeline_ingest(
+                        ingest_threads_rate, _ = _bench_pipeline_ingest(
                             files, cfg, 0
                         )
-                        ingest_procs_rate = _bench_pipeline_ingest(
-                            files, cfg, bench_procs
+                        ingest_procs_rate, ring_zero_copy_frac = (
+                            _bench_pipeline_ingest(files, cfg, bench_procs)
                         )
                     except Exception as e:  # noqa: BLE001 - report only
                         ladder_errors.append(
@@ -770,6 +812,10 @@ def main() -> int:
         "pipeline_ingest_procs_lines_per_sec": round(
             ingest_procs_rate, 1
         ),
+        # Inbound SHM ring: fraction of the procs drain's raw windows
+        # that went zero-copy (descriptor-only queue messages); -1 if
+        # the procs drain didn't run.
+        "ring_zero_copy_frac": round(ring_zero_copy_frac, 4),
         "bench_parse_processes": bench_procs,
         "platform": platform,
         "n_chips": n_chips,
@@ -781,6 +827,16 @@ def main() -> int:
         # BENCH_r0N.json so every committed bench attributes its own
         # wall-clock.
         result["ingest_wait_frac"] = tele_report["ingest_wait_frac"]
+        # Prestacked-cache split of the judged run: fraction of
+        # dispatches whose stack was skipped (epoch-0 groups stack once
+        # in the pipeline, replays reuse them) and the mean once-per-
+        # group stack cost wherever it was paid.
+        result["prestack_hit_frac"] = tele_report.get(
+            "prestack_hit_frac", 0.0
+        )
+        result["stack_ms_per_superbatch"] = tele_report.get(
+            "stack_ms_per_superbatch", 0.0
+        )
         result["telemetry"] = tele_report
     if ladder_rung is not None:
         result["ladder_rung"] = ladder_rung

@@ -73,6 +73,18 @@ def build_argparser() -> argparse.ArgumentParser:
         help="byte budget for the epoch cache; overflowing falls back "
              "to re-parsing later epochs",
     )
+    p.add_argument(
+        "--cache_prestacked", action="store_true", default=None,
+        help="store the epoch cache as pre-stacked [K, ...] super-"
+             "batches (stacked once; replay epochs skip the transfer "
+             "stage's per-dispatch stack) — requires --cache_epochs",
+    )
+    p.add_argument(
+        "--ring_slots", type=int, default=None,
+        help="inbound shared-memory ring slots for parse_processes "
+             "(raw windows parsed in place; 0 = pickle windows over "
+             "the worker queue)",
+    )
     # Observability knobs (override the cfg file).
     p.add_argument(
         "--heartbeat_secs", type=float, default=None,
@@ -131,7 +143,7 @@ def main(argv=None) -> int:
         key: getattr(args, key)
         for key in ("steps_per_dispatch", "prefetch_super_batches",
                     "parse_processes", "cache_epochs", "cache_max_bytes",
-                    "heartbeat_secs")
+                    "cache_prestacked", "ring_slots", "heartbeat_secs")
         if getattr(args, key) is not None
     }
     if args.no_telemetry:

@@ -91,6 +91,20 @@ class FmConfig:
     # re-parsing later epochs (cache_result = "overflow").
     cache_epochs: bool = False
     cache_max_bytes: int = 1 << 30
+    # Store the epoch cache as PRE-STACKED [K, ...] super-batches
+    # (K = steps_per_dispatch), stacked once at epoch-0 group boundaries:
+    # replay epochs hand whole super-batches to the transfer stage, which
+    # skips its per-dispatch np.stack entirely.  Cross-epoch remixing
+    # drops to SUPER-batch granularity (the next step of the cache_epochs
+    # tradeoff); only engages when cache_epochs is on.
+    cache_prestacked: bool = False
+    # Inbound shared-memory ring for parse_processes: raw windows are
+    # written into one of this many fixed SHM slots and workers parse in
+    # place — only slot descriptors cross the worker queue (0 = ship
+    # window bytes over the queue like before).  Slot capacity is sized
+    # from the shuffle window; an oversized window falls back to the
+    # queue path (counted as ingest.ring_fallback_windows).
+    ring_slots: int = 4
     # Kept for config compatibility: the reference ran N shuffle-queue
     # threads between its reader and parser queues.  Here shuffling is a
     # window permutation inside the (single, sequential-IO) reader thread
@@ -243,6 +257,15 @@ class FmConfig:
             raise ValueError(
                 f"cache_max_bytes must be positive, got {self.cache_max_bytes}"
             )
+        if self.ring_slots < 0:
+            raise ValueError(
+                f"ring_slots must be >= 0, got {self.ring_slots}"
+            )
+        if self.cache_prestacked and not self.cache_epochs:
+            raise ValueError(
+                "cache_prestacked requires cache_epochs (it is a storage "
+                "format of the epoch cache)"
+            )
         if self.weight_files and len(self.weight_files) != len(self.train_files):
             raise ValueError(
                 "weight_files must parallel train_files "
@@ -335,6 +358,8 @@ _KEYMAP = {
     "parse_processes": ("parse_processes", int),
     "cache_epochs": ("cache_epochs", _parse_bool),
     "cache_max_bytes": ("cache_max_bytes", int),
+    "cache_prestacked": ("cache_prestacked", _parse_bool),
+    "ring_slots": ("ring_slots", int),
 }
 
 

@@ -93,7 +93,10 @@ def _load() -> ctypes.CDLL:
     lib.fm_parser_parse_raw.restype = ctypes.c_int64
     lib.fm_parser_parse_raw.argtypes = [
         ctypes.c_void_p,
-        ctypes.c_char_p,
+        # buf: void* instead of char* so callers can pass either bytes
+        # or a raw address into a shared-memory ring slot (ctypes
+        # converts bytes to a pointer for c_void_p params too).
+        ctypes.c_void_p,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # starts
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # ends
         ctypes.c_int64,
@@ -280,7 +283,22 @@ class NativeParser:
         chunk (no Python string per line).  Lines may be non-contiguous
         and in any order — the pipeline's line-level shuffle passes a
         permuted view of a scanned window.  Blank/comment lines become
-        weight-0 rows."""
+        weight-0 rows.
+
+        ``buf`` may be bytes or a buffer (a memoryview of a shared-
+        memory ring slot): parse workers read straight out of the
+        mapped segment, no bytes() copy."""
+        buf_arg = buf
+        holder = None
+        if not isinstance(buf, (bytes, bytearray)):
+            # Pass non-bytes buffers by raw address (the argtype is
+            # void*).  A numpy view — not a ctypes from_buffer/cast
+            # pair, whose internal _objects cycle keeps the buffer
+            # exported until a cycle collection and makes the segment's
+            # mmap unclosable at worker exit — pins the exporter for
+            # the call's duration.
+            holder = np.frombuffer(buf, np.uint8)
+            buf_arg = holder.ctypes.data
         n = len(starts)
         if n > batch_size:
             raise ValueError(f"{n} lines > batch_size {batch_size}")
@@ -294,12 +312,12 @@ class NativeParser:
         fields = np.zeros((batch_size, self.max_features), np.int32)
         w = np.zeros((batch_size,), np.float32)
         dropped = self._lib.fm_parser_parse_raw(
-            self._handle, buf, starts, ends, n, labels, ids, vals, fields,
-            w, None,
+            self._handle, buf_arg, starts, ends, n, labels, ids, vals,
+            fields, w, None,
         )
         if dropped < 0:
             bad = -int(dropped) - 1
-            text = buf[starts[bad]:ends[bad]]
+            text = bytes(buf[starts[bad]:ends[bad]])
             raise ValueError(
                 f"malformed libsvm input at chunk line {bad}: {text!r}"
             )

@@ -23,6 +23,7 @@ parsed batches so later epochs replay from memory instead of re-parsing.
 from __future__ import annotations
 
 import logging
+import pickle
 import random
 import threading
 import time
@@ -60,6 +61,21 @@ class EpochEnd(NamedTuple):
     epoch: int
 
 
+class SuperBatch(NamedTuple):
+    """A pre-stacked ``[K, ...]`` group delivered in-band.
+
+    With ``prestack_k > 0`` the pipeline stacks dispatch groups ONCE at
+    epoch-0 group boundaries and delivers (and caches) them in this
+    wrapper; :class:`DevicePrefetcher` recognizes it and ships the
+    stacked batch straight to the device, skipping its own per-dispatch
+    ``stack_batches`` — the replay epochs' host work drops to the
+    permutation loop plus the H2D put.
+    """
+
+    batch: libsvm.Batch  # every leaf carries a leading K axis
+    n: int  # batches stacked (K, or an epoch tail's K' < K)
+
+
 class _Error:
     """Carries a worker/reader exception to the consuming thread."""
 
@@ -75,13 +91,18 @@ class _ClosableQueue:
 
     ``put`` returns False (instead of blocking) once cancelled; ``get``
     returns the module-level ``_CANCELLED`` sentinel.
+
+    ``hist`` (an obs.DepthHist) records the depth every put/get saw —
+    the full occupancy distribution, not a heartbeat-time point sample,
+    so a queue flapping full↔empty between beats still shows up.
     """
 
-    def __init__(self, maxsize: int):
+    def __init__(self, maxsize: int, hist=None):
         self._items: deque = deque()
         self._max = max(1, maxsize)
         self._cv = threading.Condition()
         self._cancelled = False
+        self._hist = hist if hist is not None else obs.NULL.depth_hist("")
 
     def put(self, item) -> bool:
         with self._cv:
@@ -90,6 +111,7 @@ class _ClosableQueue:
             if self._cancelled:
                 return False
             self._items.append(item)
+            self._hist.observe(len(self._items))
             self._cv.notify_all()
             return True
 
@@ -99,6 +121,7 @@ class _ClosableQueue:
                 self._cv.wait()
             if not self._items:
                 return _CANCELLED
+            self._hist.observe(len(self._items))
             item = self._items.popleft()
             self._cv.notify_all()
             return item
@@ -291,6 +314,29 @@ def _batch_nbytes(batch: libsvm.Batch) -> int:
     return sum(a.nbytes for a in arrays)
 
 
+def _msg_bytes(msg) -> int:
+    """Serialized size of a work message for the ``ingest.work_msg_bytes``
+    counter.  Descriptor messages (rawslot/mark) are measured exactly —
+    they are ~200 B and their smallness is the claim a tier-1 test pins;
+    payload-bearing fallbacks (raw windows, line chunks) are ESTIMATED
+    from their content lengths instead of pickled a second time — with
+    them, mp.Queue's feeder already pays the full serialization once,
+    and doubling that cost to count it would re-add the parent-side tax
+    the ring exists to remove."""
+    kind = msg[0]
+    if kind in ("rawslot", "mark"):
+        return len(pickle.dumps(msg, pickle.HIGHEST_PROTOCOL))
+    if kind == "raw":
+        _, _, buf, starts_list, ends_list = msg
+        return len(buf) + sum(
+            a.nbytes for a in starts_list
+        ) + sum(a.nbytes for a in ends_list)
+    if kind == "lines":
+        _, _, lines, weights = msg
+        return sum(len(s) for s in lines) + 8 * len(weights)
+    return 0  # pragma: no cover - shutdown sentinel
+
+
 def _strided_rounds(it, shard_id: int, num_shards: int):
     """Yield every num_shards-th item, but only from COMPLETE rounds.
 
@@ -353,6 +399,7 @@ class BatchPipeline:
         sort_meta_spec=None,
         cache_epochs: bool = False,
         cache_max_bytes: int = 1 << 30,
+        prestack_k: int = 0,
         epoch_marks: bool = False,
         telemetry: Optional[obs.Telemetry] = None,
     ):
@@ -368,6 +415,15 @@ class BatchPipeline:
         self._t_parse = tel.timer("ingest.parse")
         self._t_reader_block = tel.timer("ingest.reader_block")
         self._t_out_block = tel.timer("ingest.out_block")
+        # Prestacked-cache + inbound-ring instruments: how many windows
+        # went through the SHM ring vs fell back to the pickled queue
+        # path, the descriptor bytes that DID cross the queue, and the
+        # once-per-group stack time of the prestacked cache.
+        self._t_prestack = tel.timer("ingest.prestack")
+        self._c_ring_windows = tel.counter("ingest.ring_windows")
+        self._c_ring_fallback = tel.counter("ingest.ring_fallback_windows")
+        self._c_ring_bytes = tel.counter("ingest.ring_window_bytes")
+        self._c_q_msg_bytes = tel.counter("ingest.work_msg_bytes")
         # Always-real counter (not gated on telemetry): out-of-range-id
         # batches are a data/vocabulary integrity signal the trainer
         # surfaces in its RESULTS, not just in logs or optional stages.
@@ -444,6 +500,12 @@ class BatchPipeline:
             cache_epochs and epochs > 1 and shard == (0, 1)
         )
         self._cache_max_bytes = cache_max_bytes
+        # Prestacked cache storage (cache_prestacked): dispatch groups of
+        # prestack_k batches are stacked ONCE at epoch-0 group boundaries
+        # and delivered/cached as SuperBatch items; replay epochs permute
+        # at super-batch granularity and the transfer stage skips its
+        # per-dispatch stack.  Only meaningful when the cache engages.
+        self._prestack_k = prestack_k if self._cache_epochs else 0
         # Outcome of the cache for observability: "off" | "cached" |
         # "overflow" (budget blown during epoch 0; later epochs re-parsed).
         self.cache_result = "off"
@@ -478,7 +540,13 @@ class BatchPipeline:
         # on/off overhead probe compares against a lie.
         counting = self.telemetry.enabled
         for item in inner:
-            if not isinstance(item, EpochEnd):
+            if isinstance(item, SuperBatch):
+                self._c_batches.add(item.n)
+                if counting:
+                    self._c_examples.add(
+                        int(np.count_nonzero(item.batch.weights > 0))
+                    )
+            elif not isinstance(item, EpochEnd):
                 self._c_batches.add(1)
                 if counting:
                     self._c_examples.add(
@@ -500,6 +568,9 @@ class BatchPipeline:
         REBUILD the cache (delivering nothing for already-trained
         batches), then replays from the resume position — later epochs
         come from memory instead of a per-epoch re-parse."""
+        if self._prestack_k > 0:
+            yield from self._iter_cached_prestacked(E, e0)
+            return
         cache: Optional[list] = []
         size = 0
         self.cache_result = "cached"
@@ -554,6 +625,122 @@ class BatchPipeline:
                 yield cache[i]
             # A re-parse of this epoch would have dropped the same
             # features again; keep the running counter truthful.
+            self._trunc_extra += epoch0_trunc
+            if self.epoch_marks:
+                yield EpochEnd(epoch)
+
+    @staticmethod
+    def _slice_super(sb: SuperBatch, start: int) -> SuperBatch:
+        """Leading-axis tail slice of a stacked group (views, no copy):
+        a resume position that lands inside a group delivers only the
+        group's untrained suffix."""
+        b = sb.batch
+        meta = b.sort_meta
+        if meta is not None:
+            meta = type(meta)(*(x[start:] for x in meta))
+        return SuperBatch(
+            libsvm.Batch(
+                b.labels[start:], b.ids[start:], b.vals[start:],
+                b.fields[start:], b.weights[start:], sort_meta=meta,
+            ),
+            sb.n - start,
+        )
+
+    def _iter_cached_prestacked(self, E: int, e0: int):
+        """cache_prestacked delivery: epoch 0 streams as usual but every
+        ``prestack_k`` delivered batches are stacked ONCE into a [K, ...]
+        SuperBatch (the epoch tail stacks at K' = leftover) which is
+        both delivered and cached; epochs 1..E-1 replay the cached
+        super-batches in a seeded per-epoch permutation.  The batches
+        inside every group are byte-identical to the plain cached path —
+        only the replay permutation granularity changes (super-batch
+        instead of batch, the documented tradeoff).  Resume mirrors
+        ``_iter_cached``: epoch 0 re-parses to rebuild, the skip count
+        is consumed in whole groups (a trainer position always lands on
+        a group boundary; a foreign mid-group skip delivers the group's
+        sliced tail)."""
+        k = self._prestack_k
+        cache: Optional[list] = []
+        size = 0
+        self.cache_result = "cached"
+        deliver = e0 == 0
+        skip = self.skip_batches
+        trunc_start = self.truncated_features
+        n_seen = 0  # batches consumed from the epoch-0 stream
+        group: list = []
+        stream = self._iter_stream(1, 0, 0)
+
+        def flush_group():
+            """Stack the pending group once; cache + deliver decisions."""
+            nonlocal size, cache, group
+            if not group:
+                return None
+            with self._t_prestack.time():
+                sb = SuperBatch(stack_batches(group), len(group))
+            start_idx = n_seen - len(group)
+            group = []
+            if cache is not None:
+                size += _batch_nbytes(sb.batch)
+                if size > self._cache_max_bytes:
+                    log.info(
+                        "ingest cache over budget (%d > %d bytes); "
+                        "re-parsing later epochs", size,
+                        self._cache_max_bytes,
+                    )
+                    cache = None
+                    self.cache_result = "overflow"
+                else:
+                    cache.append(sb)
+            if not deliver:
+                return None
+            if start_idx >= skip:
+                return sb
+            if n_seen > skip:  # mid-group resume: deliver the tail
+                return self._slice_super(sb, skip - start_idx)
+            return None
+
+        try:
+            for item in stream:
+                if isinstance(item, EpochEnd):
+                    out = flush_group()  # epoch tail: K' = leftover
+                    if out is not None:
+                        yield out
+                    if deliver and self.epoch_marks:
+                        yield item
+                    if cache is None and not deliver:
+                        break  # rebuild-only parse overflowed: stop early
+                    continue
+                group.append(item)
+                n_seen += 1
+                if len(group) == k:
+                    out = flush_group()
+                    if out is not None:
+                        yield out
+                    if cache is None and not deliver:
+                        break
+        finally:
+            stream.close()
+        if cache is None:  # budget blown: stream the remaining epochs
+            if deliver:
+                if E > 1:
+                    yield from self._emit_stream(E - 1, 1, 0)
+            else:
+                yield from self._emit_stream(E - e0, e0, skip)
+            return
+        epoch0_trunc = self.truncated_features - trunc_start
+        for epoch in range(max(1, e0), E):
+            order = list(range(len(cache)))
+            if self.shuffle:
+                random.Random(self.seed + epoch).shuffle(order)
+            rem = skip if epoch == e0 else 0
+            for gi in order:
+                sb = cache[gi]
+                if rem >= sb.n:
+                    rem -= sb.n
+                    continue
+                self._c_cache_replays.add(sb.n - rem)
+                yield self._slice_super(sb, rem) if rem else sb
+                rem = 0
             self._trunc_extra += epoch0_trunc
             if self.epoch_marks:
                 yield EpochEnd(epoch)
@@ -685,14 +872,19 @@ class BatchPipeline:
         self, n_epochs: int, first_epoch: int, skip: int
     ) -> Iterator:
         cfg = self.cfg
-        work = _ClosableQueue(max(2, cfg.queue_size))
-        out = _ClosableQueue(max(2, cfg.queue_size))
+        # Per-put/get depth histograms (not heartbeat-time point samples:
+        # a flapping queue shows its full occupancy distribution).  work
+        # deep + out shallow = parse-bound; work shallow + out deep = the
+        # consumer (training) is the bottleneck.
+        work = _ClosableQueue(
+            max(2, cfg.queue_size),
+            hist=self.telemetry.depth_hist("ingest.work_q_depth"),
+        )
+        out = _ClosableQueue(
+            max(2, cfg.queue_size),
+            hist=self.telemetry.depth_hist("ingest.out_q_depth"),
+        )
         n_workers = max(1, cfg.thread_num)
-        # Queue-depth gauges, sampled when a snapshot is taken (heartbeat
-        # cadence).  work deep + out shallow = parse-bound; work shallow
-        # + out deep = the consumer (training) is the bottleneck.
-        self.telemetry.sample("ingest.work_q_depth", work.qsize)
-        self.telemetry.sample("ingest.out_q_depth", out.qsize)
 
         def reader():
             try:
@@ -790,15 +982,36 @@ class BatchPipeline:
             for t in threads:
                 t.join()
 
+    def _ring_slot_bytes(self) -> int:
+        """Ring slot capacity for this config's raw windows: text bytes
+        (window lines at a generous 1 KB/line, plus one read chunk of
+        accumulation overshoot) + the 16 B/line offset arrays.  A window
+        that still outgrows this falls back to the pickled queue path —
+        counted, never wrong — so the estimate only has to be right for
+        the common case."""
+        cfg = self.cfg
+        window_lines = (
+            max(cfg.shuffle_buffer, cfg.batch_size)
+            if self.shuffle else cfg.batch_size
+        )
+        want = window_lines * (1024 + 16) + 2 * _CHUNK_BYTES
+        return min(max(want, 1 << 20), 64 << 20)
+
     def _iter_stream_procs(
         self, n_epochs: int, first_epoch: int, skip: int
     ) -> Iterator:
         """Multiprocess parse: the reader thread coalesces work by raw
-        window (each window's bytes cross the queue ONCE) and a spawned
-        worker pool parses + preps batches, shipping them back as shared
-        memory segments (data.procpool) — parsing never touches this
-        process's GIL, which is what makes ``thread_num`` useless on the
-        pure-Python parse path."""
+        window and a spawned worker pool parses + preps batches, shipping
+        them back as shared memory segments (data.procpool) — parsing
+        never touches this process's GIL, which is what makes
+        ``thread_num`` useless on the pure-Python parse path.
+
+        With ``ring_slots > 0`` the raw direction is zero-copy too: the
+        reader writes each window (text + offsets) into a slot of an
+        inbound SHM ring and only slot DESCRIPTORS cross the work queue;
+        workers parse in place and recycle slots over a free queue.
+        Windows larger than a slot (and the line path) fall back to
+        pickling through the queue, exactly as before."""
         import multiprocessing as mp
         import queue as _q
 
@@ -812,6 +1025,16 @@ class BatchPipeline:
         work = ctx.Queue(maxsize=max(2, min(cfg.queue_size, 2 * n_workers)))
         out = ctx.Queue(maxsize=max(2, cfg.queue_size))
         stop = ctx.Event()
+        shm_tag = procpool.make_shm_tag()
+        ring = None
+        ring_free = None
+        if self._raw and cfg.ring_slots > 0:
+            ring = procpool.ShmRing.create(
+                shm_tag, cfg.ring_slots, self._ring_slot_bytes()
+            )
+            ring_free = ctx.Queue(maxsize=cfg.ring_slots + 1)
+            for i in range(cfg.ring_slots):
+                ring_free.put(i)
         spec = procpool.WorkerSpec(
             vocabulary_size=cfg.vocabulary_size,
             max_features=cfg.max_features,
@@ -820,23 +1043,40 @@ class BatchPipeline:
             batch_size=cfg.batch_size,
             use_native=self._native is not None,
             sort_meta_spec=self._sort_meta_spec,
+            shm_tag=shm_tag,
+            ring_name=ring.name if ring is not None else None,
+            ring_slots=cfg.ring_slots,
+            ring_slot_bytes=ring.slot_bytes if ring is not None else 0,
         )
         procs = [
             ctx.Process(
                 target=procpool.parse_worker_main,
-                args=(spec, work, out, stop), daemon=True,
+                args=(spec, work, out, stop, ring_free), daemon=True,
             )
             for _ in range(n_workers)
         ]
         for p in procs:
             p.start()
-        # mp.Queue.qsize is approximate (and unimplemented on some
-        # platforms — snapshot() degrades a raising sample to -1).
-        self.telemetry.sample("ingest.work_q_depth", work.qsize)
-        self.telemetry.sample("ingest.out_q_depth", out.qsize)
+        # Depth histograms around the parent-side queue ends (mp.Queue
+        # qsize is approximate, and can raise on exotic platforms — the
+        # helper degrades to not observing).
+        h_work = self.telemetry.depth_hist("ingest.work_q_depth")
+        h_out = self.telemetry.depth_hist("ingest.out_q_depth")
+        h_ring = self.telemetry.depth_hist("ingest.ring_free_slots")
+
+        def observe_depth(hist, q):
+            try:
+                hist.observe(q.qsize())
+            except (NotImplementedError, OSError):  # pragma: no cover
+                pass
 
         def put_mp(q, item) -> bool:
             return procpool.put_with_stop(q, item, stop)
+
+        # Descriptor-size accounting only when telemetry is live: the
+        # whole point of the ring is that work messages shrink to slot
+        # descriptors, and the counter is what proves it (tier-1 test).
+        counting = self.telemetry.enabled
 
         reader_err: list = []
 
@@ -846,6 +1086,9 @@ class BatchPipeline:
             def put_work(msg) -> bool:
                 # Same producer-block accounting as the thread path: time
                 # waiting for a work-queue slot (parse-bound signal).
+                if counting:
+                    self._c_q_msg_bytes.add(_msg_bytes(msg))
+                observe_depth(h_work, work)
                 t0 = time.perf_counter()
                 ok = put_mp(work, msg)
                 self._t_reader_block.observe(time.perf_counter() - t0)
@@ -855,9 +1098,37 @@ class BatchPipeline:
                 nonlocal pend
                 if pend is None:
                     return True
-                msg = ("raw", pend[1], pend[0], pend[2], pend[3])
+                buf, seq0, starts_list, ends_list = (
+                    pend[0], pend[1], pend[2], pend[3]
+                )
                 pend = None
-                return put_work(msg)
+                n_lines = sum(len(s) for s in starts_list)
+                if (
+                    ring is not None
+                    and procpool.ShmRing.need_bytes(len(buf), n_lines)
+                    <= ring.slot_bytes
+                ):
+                    observe_depth(h_ring, ring_free)
+                    slot = procpool.get_with_stop(ring_free, stop)
+                    if slot is None:
+                        return False
+                    ring.write(
+                        slot, buf,
+                        np.concatenate(starts_list),
+                        np.concatenate(ends_list),
+                    )
+                    self._c_ring_windows.add(1)
+                    self._c_ring_bytes.add(len(buf))
+                    return put_work((
+                        "rawslot", seq0, slot, len(buf),
+                        [len(s) for s in starts_list],
+                    ))
+                # Oversized window (or ring off): the window's bytes
+                # cross the queue pickled, exactly the old contract.
+                self._c_ring_fallback.add(1)
+                return put_work(
+                    ("raw", seq0, bytes(buf), starts_list, ends_list)
+                )
 
             try:
                 for seq, item in self._epoch_items(
@@ -903,6 +1174,7 @@ class BatchPipeline:
             while expect_done > 0:
                 if reader_err:
                     raise reader_err.pop()
+                observe_depth(h_out, out)
                 try:
                     msg = out.get(timeout=0.1)
                 except _q.Empty:
@@ -964,9 +1236,22 @@ class BatchPipeline:
                         procpool.discard_segment(msg[2])
             except _q.Empty:
                 pass
-            for q in (work, out):
+            if ring is not None:
+                ring.destroy()
+            qs = (work, out) if ring_free is None else (
+                work, out, ring_free
+            )
+            for q in qs:
                 q.close()
                 q.cancel_join_thread()
+            # Backstop for segments a crashed worker created but never
+            # shipped: everything this pipeline tagged is garbage now.
+            leaked = procpool.sweep_segments(shm_tag)
+            if leaked:
+                log.warning(
+                    "swept %d orphaned /dev/shm segment(s) tagged %s "
+                    "(a parse worker died mid-ship)", leaked, shm_tag,
+                )
 
     def _log_worker_note(self, note) -> None:
         """Mirror thread-mode sort_meta degradation logging for notes a
@@ -989,7 +1274,9 @@ class BatchPipeline:
             )
 
 
-def stack_batches(batches: Sequence[libsvm.Batch]) -> libsvm.Batch:
+def stack_batches(
+    batches: Sequence[libsvm.Batch], out: Optional[libsvm.Batch] = None
+) -> libsvm.Batch:
     """Stack K parsed batches into one [K, batch, ...] super-batch.
 
     The stacked Batch feeds the K-step scan train step (train.loop.
@@ -1000,6 +1287,13 @@ def stack_batches(batches: Sequence[libsvm.Batch]) -> libsvm.Batch:
     with any meta-less batch drops it entirely — the device-sort path
     handles meta-less batches, and a per-step mix would change the scan
     xs pytree mid-run.
+
+    ``out`` (a Batch of preallocated [K, ...] arrays, sort_meta arrays
+    included iff this group stacks meta) receives the stacked data in
+    place and is returned — the transfer stage's staging-buffer pool
+    recycles these so steady-state stacking allocates nothing.  Callers
+    passing ``out`` must not reuse the buffers until the consumer is
+    done with the returned Batch.
     """
     if not batches:
         raise ValueError("stack_batches needs at least one batch")
@@ -1012,18 +1306,113 @@ def stack_batches(batches: Sequence[libsvm.Batch]) -> libsvm.Batch:
             b.labels[None], b.ids[None], b.vals[None], b.fields[None],
             b.weights[None], sort_meta=meta,
         )
-    core = (
-        np.stack([b.labels for b in batches]),
-        np.stack([b.ids for b in batches]),
-        np.stack([b.vals for b in batches]),
-        np.stack([b.fields for b in batches]),
-        np.stack([b.weights for b in batches]),
-    )
     metas = [b.sort_meta for b in batches]
-    meta = None
-    if all(m is not None for m in metas):
-        meta = type(metas[0])(*(np.stack(cols) for cols in zip(*metas)))
-    return libsvm.Batch(*core, sort_meta=meta)
+    has_meta = all(m is not None for m in metas)
+    if out is None:
+        core = (
+            np.stack([b.labels for b in batches]),
+            np.stack([b.ids for b in batches]),
+            np.stack([b.vals for b in batches]),
+            np.stack([b.fields for b in batches]),
+            np.stack([b.weights for b in batches]),
+        )
+        meta = None
+        if has_meta:
+            meta = type(metas[0])(
+                *(np.stack(cols) for cols in zip(*metas))
+            )
+        return libsvm.Batch(*core, sort_meta=meta)
+    for name in ("labels", "ids", "vals", "fields", "weights"):
+        np.stack(
+            [getattr(b, name) for b in batches], out=getattr(out, name)
+        )
+    if has_meta:
+        if out.sort_meta is None:
+            raise ValueError("out has no sort_meta arrays for this group")
+        for cols, dst in zip(zip(*metas), out.sort_meta):
+            np.stack(cols, out=dst)
+        return out
+    return out._replace(sort_meta=None)
+
+
+class _StagingPool:
+    """Reusable pre-allocated host staging buffers for super-batch
+    stacking (single-threaded: only the transfer thread touches it).
+
+    Steady-state stacking writes into recycled [K, ...] arrays instead
+    of allocating ~super-batch bytes per dispatch.  A buffer is only
+    recycled after the device transfer that read from it is COMPLETE:
+    retired buffers queue behind their device super-batch and the pool
+    blocks on the oldest transfer (``jax.block_until_ready``, resolved
+    lazily so the data layer stays importable without jax) before
+    handing its buffers out again.  By the time super-batch n + depth
+    stacks, transfer n has long finished, so the wait is ~0 in steady
+    state.  Keyed by (K, batch shape, has-meta) — epoch tails at
+    K' < K get their own small slot.
+    """
+
+    def __init__(self, limit: int, reuse_counter=None):
+        self._free: dict = {}  # key -> [Batch bufset, ...]
+        self._inflight: deque = deque()  # (dev, key, bufset)
+        self._limit = max(1, limit)
+        self._c_reuse = (
+            reuse_counter if reuse_counter is not None
+            else obs.NULL.counter("")
+        )
+
+    @staticmethod
+    def _key(group):
+        b = group[0]
+        has_meta = all(x.sort_meta is not None for x in group)
+        return (len(group), b.ids.shape, has_meta)
+
+    @staticmethod
+    def _alloc(group, has_meta):
+        k = len(group)
+        b = group[0]
+
+        def empty(x):
+            return np.empty((k,) + x.shape, x.dtype)
+
+        meta = None
+        if has_meta:
+            meta = type(b.sort_meta)(*(empty(x) for x in b.sort_meta))
+        return libsvm.Batch(
+            empty(b.labels), empty(b.ids), empty(b.vals),
+            empty(b.fields), empty(b.weights), sort_meta=meta,
+        )
+
+    @staticmethod
+    def _wait(dev) -> None:
+        """Block until a shipped super-batch's H2D transfers finished —
+        only then are its staging buffers safe to overwrite.  jax is
+        resolved lazily (and only if already imported): a numpy-only
+        put_fn has nothing to wait for."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return
+        try:
+            jax.block_until_ready(dev)
+        except Exception:  # pragma: no cover - non-array put results
+            pass
+
+    def acquire(self, group) -> libsvm.Batch:
+        key = self._key(group)
+        while len(self._inflight) >= self._limit:
+            dev, k2, bufs = self._inflight.popleft()
+            self._wait(dev)
+            self._free.setdefault(k2, []).append(bufs)
+        free = self._free.get(key)
+        if free:
+            self._c_reuse.add(1)
+            return free.pop()
+        return self._alloc(group, key[2])
+
+    def retire(self, dev, group, bufs: libsvm.Batch) -> None:
+        """Queue the buffers behind their device transfer for reuse."""
+        self._inflight.append((dev, self._key(group), bufs))
 
 
 class DevicePrefetcher:
@@ -1043,6 +1432,11 @@ class DevicePrefetcher:
     :class:`EpochEnd` marker from the source flushes the pending group
     (so super-batches never span epochs — the epoch tail dispatches at
     K' = leftover, exactly like before) and is forwarded verbatim.
+    A :class:`SuperBatch` from the source (the pre-stacked epoch cache)
+    skips ``stack_batches`` entirely and ships as-is; with
+    ``staging=True`` the stacking path writes into a small pool of
+    recycled pre-allocated host buffers (safe only when ``put_fn``
+    copies out of host memory, as device_put does).
     Exceptions from the source or the transfer re-raise in the consumer;
     ``close()`` cancels the output queue (waking a blocked producer
     immediately — no poll latency) and joins the thread; it is
@@ -1050,19 +1444,33 @@ class DevicePrefetcher:
     """
 
     def __init__(self, source, steps_per_dispatch: int, put_fn,
-                 depth: int = 2, telemetry: Optional[obs.Telemetry] = None):
+                 depth: int = 2, telemetry: Optional[obs.Telemetry] = None,
+                 staging: bool = False):
         self._k = max(1, steps_per_dispatch)
         self._put_fn = put_fn
-        self._out = _ClosableQueue(max(1, depth))
         # Transfer-stage instruments: stack vs H2D vs output-block time.
         # out_block large = the device is the bottleneck (healthy);
-        # out_q_depth ~0 with the trainer starving = ingest-bound.
+        # out_q_depth pinned low with the trainer starving = ingest-bound.
         tel = telemetry if telemetry is not None else obs.NULL
+        self._out = _ClosableQueue(
+            max(1, depth), hist=tel.depth_hist("prefetch.out_q_depth")
+        )
         self._t_stack = tel.timer("prefetch.stack")
         self._t_put = tel.timer("prefetch.device_put")
         self._t_out_block = tel.timer("prefetch.out_block")
         self._c_super = tel.counter("prefetch.super_batches")
-        tel.sample("prefetch.out_q_depth", self._out.qsize)
+        self._c_prestack = tel.counter("prefetch.prestack_hits")
+        # Staging-buffer reuse is opt-in: it requires put_fn to COPY out
+        # of the host arrays (device_put does; an identity put_fn used
+        # by tests/bench drains hands the arrays downstream, where a
+        # recycled buffer would be overwritten under the consumer).
+        self._pool = (
+            _StagingPool(
+                max(1, depth) + 1,
+                reuse_counter=tel.counter("prefetch.staging_reuse"),
+            )
+            if staging else None
+        )
         self._thread = threading.Thread(
             target=self._run, args=(iter(source),), daemon=True
         )
@@ -1081,6 +1489,19 @@ class DevicePrefetcher:
                             return
                         group = []
                     if not self._out.put(batch):
+                        return
+                    continue
+                if isinstance(batch, SuperBatch):
+                    # Pre-stacked fast path (cache_prestacked replay —
+                    # and epoch 0, which the pipeline stacks once at
+                    # group boundaries): no stack here, straight to the
+                    # device.  A pending partial group (mid-group
+                    # resume tail) flushes first to keep order.
+                    if group:
+                        if not self._emit(group):
+                            return
+                        group = []
+                    if not self._emit_prestacked(batch):
                         return
                     continue
                 group.append(batch)
@@ -1104,13 +1525,31 @@ class DevicePrefetcher:
                     pass
 
     def _emit(self, group) -> bool:
+        bufs = None
         with self._t_stack.time(), obs.trace_span("tffm:stack"):
-            stacked = stack_batches(group)
+            if self._pool is not None and len(group) > 1:
+                bufs = self._pool.acquire(group)
+                stacked = stack_batches(group, out=bufs)
+            else:
+                stacked = stack_batches(group)
         with self._t_put.time(), obs.trace_span("tffm:h2d"):
             dev = self._put_fn(stacked)
+        if bufs is not None:
+            self._pool.retire(dev, group, bufs)
         self._c_super.add(1)
         t0 = time.perf_counter()
         ok = self._out.put((dev, len(group)))
+        self._t_out_block.observe(time.perf_counter() - t0)
+        return ok
+
+    def _emit_prestacked(self, sb: SuperBatch) -> bool:
+        """Ship an already-stacked group: zero stacking work, one put."""
+        with self._t_put.time(), obs.trace_span("tffm:h2d"):
+            dev = self._put_fn(sb.batch)
+        self._c_super.add(1)
+        self._c_prestack.add(1)
+        t0 = time.perf_counter()
+        ok = self._out.put((dev, sb.n))
         self._t_out_block.observe(time.perf_counter() - t0)
         return ok
 

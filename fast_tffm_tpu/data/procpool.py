@@ -8,13 +8,27 @@ C++ ``FmParser`` threads: the pure-Python parse fallback is GIL-bound no
 matter what ``thread_num`` says, and even the ctypes path serializes its
 Python-side batch assembly — worker processes sidestep both.
 
-Parsed batches travel back over POSIX shared memory
-(``multiprocessing.shared_memory``): the worker lays the batch's
-contiguous numpy arrays (and, when host sort prep is on, the sort_meta
-arrays — all shapes are static given the config) into ONE segment and
-ships just the segment name over the result queue.  The parent maps the
-segment and wraps zero-copy views, so the only post-parse copy is
-``np.stack`` gathering the super-batch in ``stack_batches``.
+Both directions of the worker queue are shared-memory backed:
+
+- INBOUND (:class:`ShmRing`): the reader writes each raw window's bytes
+  (text + line offsets) straight into a slot of one fixed ring segment;
+  only a slot DESCRIPTOR (slot id, lengths, group sizes — a few hundred
+  bytes) crosses the work queue, and workers parse in place from the
+  mapped slot.  The previous design pickled every window's multi-MB
+  byte buffer through the queue.  Workers return the slot id on a free
+  queue once the window is fully parsed; a window that outgrows the
+  slot capacity falls back to the pickled path (counted, never wrong).
+- OUTBOUND (``ship_batch``/``attach_batch``): the worker lays the
+  parsed batch's contiguous numpy arrays (and, when host sort prep is
+  on, the sort_meta arrays — all shapes are static given the config)
+  into ONE per-batch segment and ships just the segment name over the
+  result queue.  The parent maps the segment and wraps zero-copy views,
+  so the only post-parse copy is the super-batch stacking.
+
+Every segment a pipeline creates (the ring and all shipped batches)
+carries the pipeline's unique ``shm_tag`` name prefix, so teardown can
+sweep ``/dev/shm`` for stragglers — a worker killed between creating a
+segment and shipping its name can no longer leak it.
 
 Segment lifecycle (Python 3.10: no ``track=False``):
 
@@ -30,6 +44,7 @@ Segment lifecycle (Python 3.10: no ``track=False``):
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import pickle
 import queue as _queue
@@ -40,6 +55,18 @@ from typing import Optional
 import numpy as np
 
 from fast_tffm_tpu.data.libsvm import Batch, SortMeta
+
+_SHM_DIR = "/dev/shm"
+_pipe_ids = itertools.count()
+_ship_ids = itertools.count()
+
+
+def make_shm_tag() -> str:
+    """Unique per-pipeline prefix for every segment the pipeline (or its
+    workers) creates — the handle :func:`sweep_segments` cleans up by.
+    The trailing delimiter matters: without it, pipeline p1's teardown
+    sweep would prefix-match pipeline p10's live segments."""
+    return f"tffm{os.getpid()}p{next(_pipe_ids)}_"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +81,10 @@ class WorkerSpec:
     batch_size: int
     use_native: bool  # parent's parser choice; children must match it
     sort_meta_spec: Optional[tuple]  # (vocab, chunk, tile) or None
+    shm_tag: str = "tffm0p0"  # name prefix for all segments of this run
+    ring_name: Optional[str] = None  # inbound ShmRing segment (None = off)
+    ring_slots: int = 0
+    ring_slot_bytes: int = 0
 
 
 _CORE = ("labels", "ids", "vals", "fields", "weights")
@@ -101,17 +132,153 @@ def _nbytes(fields) -> int:
     )
 
 
-def ship_batch(spec: WorkerSpec, batch: Batch, has_meta: bool) -> str:
-    """Worker side: copy one parsed batch into a fresh segment; returns
-    its name.  The worker's tracker registration is removed — the PARENT
-    owns cleanup (it unlinks on attach, or discard_segment on teardown)."""
-    core, meta = _layout(spec)
-    fields = core + (meta if has_meta else [])
-    shm = shared_memory.SharedMemory(create=True, size=max(1, _nbytes(fields)))
+def _pad8(n: int) -> int:
+    return (n + 7) & ~7
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """Remove this process's resource-tracker registration for a segment
+    whose lifetime someone else owns (the tracker would otherwise unlink
+    it when THIS process exits, yanking pages from live users)."""
     try:
         resource_tracker.unregister(shm._name, "shared_memory")
     except Exception:  # pragma: no cover - tracker impl drift
         pass
+
+
+class ShmRing:
+    """Inbound shared-memory ring: raw windows, parent → workers.
+
+    One fixed segment of ``slots`` × ``slot_bytes``.  The parent writes a
+    window (text bytes, then the 8-aligned int64 starts/ends offset
+    arrays) into a free slot and ships only the slot descriptor; workers
+    map the same segment at startup, parse straight out of the slot, and
+    return the slot id on a free queue.  Free-slot flow control IS the
+    ring's backpressure — the reader blocks on the free queue when every
+    slot is in flight.
+
+    The creating (parent) process keeps its resource-tracker
+    registration while the ring lives, so a hard-killed parent still
+    gets the segment unlinked at tracker exit; :meth:`destroy` is the
+    clean path (unlink + unregister, idempotent).  Workers attach with
+    :meth:`attach` and drop their own tracker registration — the parent
+    owns cleanup.
+    """
+
+    def __init__(self, shm: shared_memory.SharedMemory, slots: int,
+                 slot_bytes: int):
+        self._shm = shm
+        self.name = shm.name
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+
+    @classmethod
+    def create(cls, tag: str, slots: int, slot_bytes: int) -> "ShmRing":
+        shm = shared_memory.SharedMemory(
+            create=True, size=max(1, slots * slot_bytes),
+            name=f"{tag}ring",
+        )
+        return cls(shm, slots, slot_bytes)
+
+    @classmethod
+    def attach(cls, name: str, slots: int, slot_bytes: int) -> "ShmRing":
+        shm = shared_memory.SharedMemory(name=name)
+        # No unregister here: spawned workers SHARE the parent's
+        # resource-tracker process (the fd rides the spawn handshake)
+        # and its cache is a set — an attach's duplicate registration
+        # collapses into the parent's entry, so a worker-side
+        # unregister would steal that entry and the parent's final
+        # unlink would log a tracker KeyError.  The duplicate register
+        # is harmless; the entry dies with the parent's unlink.
+        return cls(shm, slots, slot_bytes)
+
+    def write(self, slot: int, text, starts: np.ndarray,
+              ends: np.ndarray) -> int:
+        """Lay one window into ``slot``; returns bytes written.  Layout:
+        ``[text][pad to 8][starts int64 x n][ends int64 x n]``."""
+        base = slot * self.slot_bytes
+        mv = self._shm.buf
+        tl = len(text)
+        mv[base:base + tl] = text
+        off = base + _pad8(tl)
+        n = len(starts)
+        dst = np.frombuffer(mv, np.int64, count=2 * n, offset=off)
+        dst[:n] = starts
+        dst[n:] = ends
+        del dst  # drop the buffer export before any close()
+        return _pad8(tl) + 16 * n
+
+    def read(self, slot: int, text_len: int, n: int):
+        """(text_memoryview, starts, ends) zero-copy views of a slot."""
+        base = slot * self.slot_bytes
+        text = memoryview(self._shm.buf)[base:base + text_len]
+        off = base + _pad8(text_len)
+        arr = np.frombuffer(self._shm.buf, np.int64, count=2 * n,
+                            offset=off)
+        return text, arr[:n], arr[n:]
+
+    @staticmethod
+    def need_bytes(text_len: int, n_lines: int) -> int:
+        return _pad8(text_len) + 16 * n_lines
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except BufferError:  # pragma: no cover - a view still exported
+            pass
+
+    def destroy(self) -> None:
+        """Parent-side teardown (idempotent): unlink — which also drops
+        this process's tracker registration — and close the mapping.  A
+        name already gone (swept externally) still needs the tracker
+        registration cleared or exit-time cleanup warns about it."""
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            _untrack(self._shm)
+        self.close()
+
+
+def sweep_segments(tag: str) -> int:
+    """Unlink every /dev/shm segment carrying ``tag`` — the teardown
+    backstop for segments a crashed worker created but never shipped
+    (and for the ring, had destroy() not run).  Only called after the
+    worker pool is reaped, so nothing tagged is still in use.  Returns
+    the number of segments removed."""
+    removed = 0
+    try:
+        names = os.listdir(_SHM_DIR)
+    except OSError:  # pragma: no cover - non-Linux /dev/shm layout
+        return 0
+    for name in names:
+        if name.startswith(tag):
+            try:
+                os.unlink(os.path.join(_SHM_DIR, name))
+                removed += 1
+            except OSError:  # pragma: no cover - raced another cleaner
+                pass
+    return removed
+
+
+def ship_batch(spec: WorkerSpec, batch: Batch, has_meta: bool) -> str:
+    """Worker side: copy one parsed batch into a fresh segment; returns
+    its name.  The worker's tracker registration is removed — the PARENT
+    owns cleanup (it unlinks on attach, or discard_segment on teardown).
+    Segments carry the run's shm_tag so a crashed worker's orphans are
+    still findable by the parent's teardown sweep."""
+    core, meta = _layout(spec)
+    fields = core + (meta if has_meta else [])
+    size = max(1, _nbytes(fields))
+    while True:
+        name = f"{spec.shm_tag}o{os.getpid()}x{next(_ship_ids)}"
+        try:
+            shm = shared_memory.SharedMemory(
+                create=True, size=size, name=name
+            )
+            break
+        except FileExistsError:  # pragma: no cover - counter collision
+            continue
+    _untrack(shm)
     off = 0
     values = {name: getattr(batch, name) for name in _CORE}
     if has_meta:
@@ -213,6 +380,17 @@ def put_with_stop(q, item, stop) -> bool:
     return False
 
 
+def get_with_stop(q, stop):
+    """Blocking mp-queue get that gives up (returns None) once ``stop``
+    is set — used by the reader waiting for a free ring slot."""
+    while not stop.is_set():
+        try:
+            return q.get(timeout=0.1)
+        except _queue.Empty:
+            continue
+    return None
+
+
 def _safe_exc(e: BaseException) -> BaseException:
     """An exception guaranteed to survive the result queue's pickling
     (an unpicklable error would be dropped by the feeder thread and the
@@ -274,13 +452,19 @@ def _build_parser(spec: WorkerSpec):
     return parse_lines_py, parse_raw_py, lambda: 0
 
 
-def parse_worker_main(spec: WorkerSpec, work, out, stop) -> None:
+def parse_worker_main(spec: WorkerSpec, work, out, stop,
+                      ring_free=None) -> None:
     """Entry point of one spawned parse worker.
 
     Work messages (from the pipeline's reader thread):
-      ("raw",   seq0, buf, [starts...], [ends...])  — one raw WINDOW,
-          sliced into len(starts) consecutive groups seq0, seq0+1, ...
-          (the window's bytes cross the queue once, not once per group);
+      ("rawslot", seq0, slot, text_len, [n_lines...]) — one raw WINDOW
+          already resident in the shared-memory ring (spec.ring_name):
+          the descriptor names the slot and the per-group line counts;
+          the worker parses IN PLACE from the mapped slot and returns
+          the slot id on ``ring_free`` when the window is done — no
+          window bytes ever cross the queue;
+      ("raw",   seq0, buf, [starts...], [ends...])  — pickled-window
+          fallback (ring off, or a window larger than a ring slot);
       ("lines", seq, lines, weights)                — one line-path chunk;
       ("mark",  seq, epoch)                         — epoch marker, echoed;
       None                                          — shutdown sentinel.
@@ -296,6 +480,11 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop) -> None:
     """
     parse_lines, parse_raw, trunc = _build_parser(spec)
     meta_spec = spec.sort_meta_spec
+    ring = None
+    if spec.ring_name is not None:
+        ring = ShmRing.attach(
+            spec.ring_name, spec.ring_slots, spec.ring_slot_bytes
+        )
 
     def put(msg) -> bool:
         return put_with_stop(out, msg, stop)
@@ -345,7 +534,27 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop) -> None:
                 if not put(msg):
                     return
                 continue
-            if kind == "raw":
+            if kind == "rawslot":
+                # Zero-copy window: parse straight out of the mapped
+                # ring slot, then hand the slot back for reuse.
+                _, seq0, slot, text_len, sizes = msg
+                buf, starts, ends = ring.read(slot, text_len, sum(sizes))
+                try:
+                    pos = 0
+                    for j, n in enumerate(sizes):
+                        before = trunc()
+                        t0 = time.perf_counter()
+                        batch = parse_raw(
+                            buf, starts[pos:pos + n], ends[pos:pos + n]
+                        )
+                        dt = time.perf_counter() - t0
+                        pos += n
+                        if not emit(batch, seq0 + j, trunc() - before, dt):
+                            return
+                finally:
+                    del buf, starts, ends  # drop the slot's buffer exports
+                    ring_free.put(slot)
+            elif kind == "raw":
                 _, seq0, buf, starts_list, ends_list = msg
                 for j, (s, e) in enumerate(zip(starts_list, ends_list)):
                     before = trunc()

@@ -11,10 +11,10 @@ or numpy imports).
 
 from fast_tffm_tpu.obs.heartbeat import Heartbeat, JsonlWriter
 from fast_tffm_tpu.obs.telemetry import (
-    NULL, Counter, Gauge, Telemetry, Timing, trace_span,
+    NULL, Counter, DepthHist, Gauge, Telemetry, Timing, trace_span,
 )
 
 __all__ = [
-    "Counter", "Gauge", "Timing", "Telemetry", "NULL", "trace_span",
-    "Heartbeat", "JsonlWriter",
+    "Counter", "Gauge", "Timing", "DepthHist", "Telemetry", "NULL",
+    "trace_span", "Heartbeat", "JsonlWriter",
 ]

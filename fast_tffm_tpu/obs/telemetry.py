@@ -12,7 +12,13 @@ into.  This module is that registry:
   (callables evaluated when a snapshot is taken: queue depths);
 - :class:`Timing` — a lock-guarded ring of recent durations with
   monotonic count/total, reporting p50/p95/max over the window (the
-  fixed ring bounds memory for million-step runs; totals stay exact).
+  fixed ring bounds memory for million-step runs; totals stay exact);
+- :class:`DepthHist` — a per-event queue-depth histogram over
+  power-of-two buckets.  Point-sampled depth gauges only see the queue
+  at heartbeat instants; a bottleneck that flaps faster than the
+  cadence (full↔empty between beats) is invisible to them.  Observing
+  the depth at every put/get costs one integer bucket increment and
+  makes the full occupancy distribution part of every snapshot.
 
 Everything hangs off a :class:`Telemetry` instance.  A disabled instance
 (``Telemetry(enabled=False)``, or the module-level :data:`NULL`) hands
@@ -39,7 +45,8 @@ import time
 from typing import Callable, Dict, Optional
 
 __all__ = [
-    "Counter", "Gauge", "Timing", "Telemetry", "NULL", "trace_span",
+    "Counter", "Gauge", "Timing", "DepthHist", "Telemetry", "NULL",
+    "trace_span",
 ]
 
 _RING = 512  # recent-window size for percentile estimates
@@ -157,6 +164,71 @@ class Timing:
         }
 
 
+_DEPTH_BUCKETS = 16  # bucket i holds depths with bit_length() == i; last open
+
+
+def _depth_bucket_label(i: int) -> str:
+    if i == 0:
+        return "0"
+    lo, hi = 1 << (i - 1), (1 << i) - 1
+    if i == _DEPTH_BUCKETS - 1:
+        return f"{lo}+"
+    return str(lo) if lo == hi else f"{lo}-{hi}"
+
+
+class DepthHist:
+    """Per-event queue-depth histogram (power-of-two buckets).
+
+    ``observe(depth)`` is called at every queue put/get with the depth
+    the event saw; the histogram accumulates how often the queue sat at
+    each occupancy band.  Unlike a snapshot-time gauge this catches
+    bottlenecks that flap between heartbeats: a queue pinned full 40%
+    of events and empty 60% reports exactly that, where a point sample
+    would report whichever extreme the beat landed on.
+    """
+
+    __slots__ = ("_lock", "_counts", "_max", "_total", "_n")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts = [0] * _DEPTH_BUCKETS
+        self._max = 0
+        self._total = 0
+        self._n = 0
+
+    def observe(self, depth: int) -> None:
+        d = int(depth)
+        if d < 0:  # an mp.Queue qsize that raised degrades to -1
+            return
+        i = min(d.bit_length(), _DEPTH_BUCKETS - 1)
+        with self._lock:
+            self._counts[i] += 1
+            self._n += 1
+            self._total += d
+            if d > self._max:
+                self._max = d
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            n, total, mx = self._n, self._total, self._max
+        if not n:
+            return {"count": 0}
+        return {
+            "count": n,
+            "mean": round(total / n, 2),
+            "max": mx,
+            "buckets": {
+                _depth_bucket_label(i): c
+                for i, c in enumerate(counts) if c
+            },
+        }
+
+
 class _NullCounter:
     __slots__ = ()
 
@@ -190,10 +262,22 @@ class _NullTiming:
         return {"count": 0, "total_s": 0.0}
 
 
+class _NullDepthHist:
+    __slots__ = ()
+    count = 0
+
+    def observe(self, depth: int) -> None:
+        pass
+
+    def snapshot(self) -> dict:
+        return {"count": 0}
+
+
 _NULL_CTX = contextlib.nullcontext()
 _NULL_COUNTER = _NullCounter()
 _NULL_GAUGE = _NullGauge()
 _NULL_TIMING = _NullTiming()
+_NULL_DEPTH = _NullDepthHist()
 
 
 class Telemetry:
@@ -212,6 +296,7 @@ class Telemetry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._timers: Dict[str, Timing] = {}
+        self._depths: Dict[str, DepthHist] = {}
         self._samples: Dict[str, Callable[[], float]] = {}
 
     def counter(self, name: str) -> Counter:
@@ -232,6 +317,12 @@ class Telemetry:
         with self._lock:
             return self._timers.setdefault(name, Timing())
 
+    def depth_hist(self, name: str) -> DepthHist:
+        if not self.enabled:
+            return _NULL_DEPTH  # type: ignore[return-value]
+        with self._lock:
+            return self._depths.setdefault(name, DepthHist())
+
     def reset(self) -> None:
         """Drop every instrument, sample, and accumulated value IN
         PLACE: references to the registry itself stay live (and future
@@ -243,6 +334,7 @@ class Telemetry:
             self._counters.clear()
             self._gauges.clear()
             self._timers.clear()
+            self._depths.clear()
             self._samples.clear()
 
     def sample(self, name: str, fn: Callable[[], float]) -> None:
@@ -265,11 +357,13 @@ class Telemetry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             timers = dict(self._timers)
+            depths = dict(self._depths)
             samples = dict(self._samples)
         out: dict = {
             "counters": {k: c.value for k, c in counters.items()},
             "gauges": {k: g.value for k, g in gauges.items()},
             "timers": {k: t.snapshot() for k, t in timers.items()},
+            "depths": {k: d.snapshot() for k, d in depths.items()},
         }
         for name, fn in samples.items():
             try:

@@ -561,6 +561,10 @@ class Trainer:
             metrics_out.write({
                 "record": "run_header",
                 "time": t0,
+                # Which process of a multi-host fleet wrote this stream:
+                # every process writes its own metrics_file, and the
+                # rank tag is what lets tools/report.py merge them.
+                "rank": jax.process_index(),
                 "config_fingerprint": _config_fingerprint(cfg),
                 "steps_per_dispatch": k,
                 "ingest_mode": (
@@ -568,6 +572,8 @@ class Trainer:
                 ),
                 "fast_ingest": cfg.fast_ingest,
                 "cache_epochs": cfg.cache_epochs,
+                "cache_prestacked": cfg.cache_prestacked,
+                "ring_slots": cfg.ring_slots,
                 "batch_size": cfg.batch_size,
                 "epoch_num": cfg.epoch_num,
                 "optimizer": cfg.optimizer,
@@ -639,6 +645,10 @@ class Trainer:
             sort_meta_spec=self._sort_meta_spec(),
             cache_epochs=cfg.cache_epochs,
             cache_max_bytes=cfg.cache_max_bytes,
+            # Pre-stacked cache storage: groups stack once at epoch-0
+            # dispatch boundaries (K = steps_per_dispatch) and replay
+            # epochs hand whole super-batches to the prefetcher.
+            prestack_k=(k if cfg.cache_prestacked else 0),
             epoch_marks=True,
             telemetry=self.telemetry,
         )
@@ -653,6 +663,10 @@ class Trainer:
             pipeline, k, self._put_super,
             depth=cfg.prefetch_super_batches,
             telemetry=self.telemetry,
+            # _put_super copies host->device, so stacking can recycle
+            # pre-allocated staging buffers instead of allocating a
+            # super-batch of host memory per dispatch.
+            staging=True,
         )
         cache_logged = not cfg.cache_epochs
 
@@ -888,7 +902,7 @@ class Trainer:
         batch composition or order belongs here: files, batch size, seed,
         the shuffle window, and which ingest path (they shuffle with
         different RNG streams)."""
-        return {
+        fp = {
             "seed": self.cfg.seed,
             "batch_size": self.cfg.batch_size,
             "train_files": list(self.cfg.train_files),
@@ -899,6 +913,14 @@ class Trainer:
             # every epoch > 0, so a saved position must not survive it.
             "cache_epochs": self.cfg.cache_epochs,
         }
+        # Prestacked replay permutes at SUPER-batch granularity, another
+        # stream redefinition for epochs > 0.  Only stamped when on, so
+        # fingerprints from pre-prestack checkpoints still match runs
+        # that leave it off.
+        if self.cfg.cache_prestacked:
+            fp["cache_prestacked"] = True
+            fp["steps_per_dispatch"] = self.cfg.steps_per_dispatch
+        return fp
 
     def save(self, stepno: int):
         checkpoint.save(

@@ -126,6 +126,65 @@ def test_cached_resume_with_overflow_matches_streaming_resume(
     assert resumed == plain[i + 1 + 3:]
 
 
+def _flat_keys(pipe):
+    """Like _keys but unpacking SuperBatch items to per-batch tuples."""
+    from fast_tffm_tpu.data.pipeline import SuperBatch
+
+    out = []
+    for b in pipe:
+        if isinstance(b, EpochEnd):
+            out.append(("mark", b.epoch))
+        elif isinstance(b, SuperBatch):
+            sb = b.batch
+            for i in range(b.n):
+                out.append((sb.labels[i].tobytes(), sb.ids[i].tobytes(),
+                            sb.vals[i].tobytes(), sb.weights[i].tobytes()))
+        else:
+            out.append((b.labels.tobytes(), b.ids.tobytes(),
+                        b.vals.tobytes(), b.weights.tobytes()))
+    return out
+
+
+def test_prestacked_pipeline_resume_matches_fresh_run(tmp_path, rng):
+    """Prestacked cache resume: a pipeline resumed at (epoch 1, batch 4)
+    re-parses epoch 0 to rebuild the STACKED cache (delivering nothing),
+    then replays exactly the fresh run's remaining super-batch sequence.
+    K=2 over 10 batches/epoch -> the skip is 2 whole groups."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, thread_num=2)
+    files = cfg.train_files
+    full = _flat_keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True,
+        cache_epochs=True, prestack_k=2, epoch_marks=True,
+    ))
+    resumed = _flat_keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True,
+        cache_epochs=True, prestack_k=2, epoch_marks=True,
+        start_epoch=1, skip_batches=4,
+    ))
+    i = full.index(("mark", 0))
+    assert resumed == full[i + 1 + 4:]
+
+
+def test_prestacked_overflow_streams_with_per_epoch_seeds(tmp_path, rng):
+    """Overflowing the budget mid-epoch-0 with prestacked storage falls
+    back to the byte-identical uncached stream, exactly like the batch
+    cache does."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, thread_num=2)
+    files = cfg.train_files
+    plain = _keys(BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True, epoch_marks=True,
+    ))
+    over = BatchPipeline(
+        files, cfg, epochs=3, shuffle=True, ordered=True, epoch_marks=True,
+        cache_epochs=True, prestack_k=2, cache_max_bytes=1,
+    )
+    got = _flat_keys(over)
+    assert over.cache_result == "overflow"
+    assert got == plain
+
+
 def test_pipeline_start_epoch_streams_remaining_epochs(tmp_path, rng):
     """Uncached start_epoch: epochs e0..E-1 stream under their own
     seeds — identical to the suffix of the full run."""
@@ -209,6 +268,75 @@ def _batch(rng, b=32, f=4, vocab=64):
     )
 
 
+def test_prefetcher_ships_prestacked_superbatches(rng):
+    """A SuperBatch from the source skips stack_batches: the prefetcher
+    ships the stacked arrays as-is (identity put -> the very objects)
+    and counts the hit."""
+    from fast_tffm_tpu import obs
+    from fast_tffm_tpu.data.pipeline import SuperBatch, stack_batches
+
+    batches = [_batch(rng) for _ in range(4)]
+    sb = SuperBatch(stack_batches(batches[:2]), 2)
+    tel = obs.Telemetry()
+    src = [sb, EpochEnd(0), batches[2], batches[3], EpochEnd(1)]
+    got = list(DevicePrefetcher(src, 2, lambda b: b, depth=4,
+                                telemetry=tel))
+    assert got[0][0] is sb.batch  # no re-stack, not even a copy
+    assert got[0][1] == 2
+    snap = tel.snapshot()
+    assert snap["counters"]["prefetch.prestack_hits"] == 1
+    assert snap["counters"]["prefetch.super_batches"] == 2
+    # the stack timer only fired for the non-prestacked group
+    assert snap["timers"]["prefetch.stack"]["count"] == 1
+
+
+def test_staging_pool_reuses_buffers_without_corruption(rng):
+    """staging=True recycles host stacking buffers; with a put_fn that
+    copies (device_put's contract) every delivered super-batch keeps
+    its own contents even after the buffers cycle many times."""
+    from fast_tffm_tpu import obs
+
+    tel = obs.Telemetry()
+    batches = [_batch(rng) for _ in range(12)]
+
+    def copying_put(stacked):
+        return Batch(*(np.copy(x) for x in stacked[:5]), sort_meta=None)
+
+    pf = DevicePrefetcher(list(batches), 2, copying_put, depth=1,
+                          telemetry=tel, staging=True)
+    got = [item for item in pf if not isinstance(item, EpochEnd)]
+    assert len(got) == 6
+    for j, (sb, n) in enumerate(got):
+        assert n == 2
+        np.testing.assert_array_equal(sb.ids[0], batches[2 * j].ids)
+        np.testing.assert_array_equal(sb.ids[1], batches[2 * j + 1].ids)
+    # the pool only holds depth+1 bufsets, so 6 emits must have recycled
+    assert tel.snapshot()["counters"]["prefetch.staging_reuse"] >= 3
+
+
+def test_device_put_copies_out_of_staging_buffers():
+    """The staging pool's safety contract on this backend: device_put
+    COPIES host memory, so a staging buffer mutated after the put does
+    not change the device array."""
+    import jax
+
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.data.pipeline import stack_batches
+    from fast_tffm_tpu.parallel import mesh as mesh_lib
+
+    cfg = FmConfig(vocabulary_size=64, factor_num=4, max_features=4,
+                   batch_size=8)
+    mesh = mesh_lib.make_mesh(cfg)
+    rng = np.random.default_rng(0)
+    group = [_batch(rng, b=8) for _ in range(2)]
+    stacked = stack_batches(group)
+    dev = mesh_lib.shard_super_batch(stacked, mesh)
+    jax.block_until_ready(dev.ids)
+    expect = np.asarray(dev.ids).copy()
+    stacked.ids[:] = -1  # recycle the staging buffer
+    np.testing.assert_array_equal(np.asarray(dev.ids), expect)
+
+
 def test_prefetcher_flushes_group_at_epoch_mark(rng):
     """An EpochEnd flushes the pending group (epoch tail at K' =
     leftover) and is forwarded in position — super-batches never span
@@ -266,6 +394,67 @@ def test_trainer_cached_midepoch_resume_bitwise(tmp_path, rng):
     # Params are the strictest stream observable (metrics are not
     # checkpointed — a resumed run accumulates only its own steps).
     assert _tree_equal(t2.state.params, full.state.params)
+
+
+def test_trainer_prestacked_trains_all_and_skips_stacks(tmp_path, rng):
+    """cache_prestacked end-to-end: every batch of every epoch trains,
+    the prefetcher's stack is skipped on EVERY dispatch (epoch 0 stacks
+    once in the pipeline; replays reuse), and the result reports the
+    cache."""
+    _write_data(tmp_path / "train.libsvm", rng)  # 10 batches/epoch
+    cfg = _cfg(tmp_path, epoch_num=3, cache_epochs=True,
+               cache_prestacked=True, steps_per_dispatch=2)
+    t = Trainer(cfg)
+    r = t.train()
+    assert r["train"]["steps"] == 30
+    assert r["train"]["examples"] == 3 * 320.0
+    assert r["train"]["ingest_cache"] == "cached"
+    snap = t.telemetry.snapshot()
+    assert snap["counters"]["prefetch.super_batches"] == 15
+    assert snap["counters"]["prefetch.prestack_hits"] == 15
+    assert snap["timers"]["ingest.prestack"]["count"] == 5  # epoch 0 only
+
+
+def test_trainer_prestacked_midepoch_resume_bitwise(tmp_path, rng):
+    """Prestacked acceptance: a checkpoint written mid-epoch-1 of a
+    prestacked 3-epoch run resumes to a bitwise-identical batch stream
+    (final params equal the uninterrupted run's)."""
+    _write_data(tmp_path / "train.libsvm", rng)  # 10 batches/epoch
+    kw = dict(epoch_num=3, cache_epochs=True, cache_prestacked=True,
+              steps_per_dispatch=2)
+    full = Trainer(_cfg(tmp_path, model_file=str(tmp_path / "mp_full"),
+                        **kw))
+    rf = full.train()
+    assert rf["train"]["steps"] == 30
+
+    cfg = _cfg(tmp_path, model_file=str(tmp_path / "mp_int"),
+               save_steps=2, **kw)
+    t = Trainer(cfg)
+    _interrupt_after_dispatches(t, 7)  # 14 batches: mid-epoch 1
+    with pytest.raises(KeyboardInterrupt):
+        t.train()
+    ds = checkpoint.restore_data_state(cfg.model_file)
+    assert ds["epoch"] == 1 and ds["batches_done"] == 4
+
+    t2 = Trainer(cfg)
+    r2 = t2.train()
+    assert r2["train"]["steps"] == 16
+    assert _tree_equal(t2.state.params, full.state.params)
+
+
+def test_fingerprint_rejects_prestack_toggle(tmp_path, rng):
+    """cache_prestacked redefines epochs > 0 (super-batch permutation);
+    a saved position from the other setting must be ignored."""
+    from conftest import set_data_state
+
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, epoch_num=2, cache_epochs=True,
+               cache_prestacked=True)
+    Trainer(cfg).train()
+    set_data_state(cfg.model_file, epoch=1, batches_done=3)
+    cfg2 = _cfg(tmp_path, epoch_num=2, cache_epochs=True)
+    r = Trainer(cfg2).train()
+    assert r["train"]["steps"] == 20  # position ignored: full fresh run
 
 
 def test_trainer_uncached_multiepoch_unchanged(tmp_path, rng):

@@ -94,22 +94,64 @@ class TestInstruments:
         assert snap["gauges"]["depth"] == 3
         assert snap["gauges"]["broken"] == -1  # raising sample degrades
 
+    def test_depth_hist_buckets_and_stats(self):
+        """Power-of-two buckets: every observed depth lands in its band;
+        mean/max/count summarize the full event stream (what a point-
+        sampled gauge cannot see between heartbeats)."""
+        h = obs.Telemetry().depth_hist("q")
+        for d in (0, 0, 1, 2, 3, 5, 9, 70):
+            h.observe(d)
+        h.observe(-1)  # degraded mp.Queue qsize: ignored
+        snap = h.snapshot()
+        assert snap["count"] == 8
+        assert snap["max"] == 70
+        assert snap["mean"] == pytest.approx(90 / 8)
+        assert snap["buckets"] == {
+            "0": 2, "1": 1, "2-3": 2, "4-7": 1, "8-15": 1, "64-127": 1,
+        }
+
+    def test_depth_hist_concurrent_writers(self):
+        h = obs.Telemetry().depth_hist("q")
+        n_threads, n_each = 6, 3000
+
+        def work():
+            for i in range(n_each):
+                h.observe(i % 7)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.snapshot()["count"] == n_threads * n_each
+
+    def test_depth_hist_in_snapshot(self):
+        tel = obs.Telemetry()
+        tel.depth_hist("ingest.work_q_depth").observe(3)
+        snap = tel.snapshot()
+        assert snap["depths"]["ingest.work_q_depth"]["count"] == 1
+        assert tel.depth_hist("x").snapshot() == {"count": 0}
+
     def test_registry_idempotent_by_name(self):
         tel = obs.Telemetry()
         assert tel.counter("a") is tel.counter("a")
         assert tel.timer("b") is tel.timer("b")
         assert tel.gauge("c") is tel.gauge("c")
+        assert tel.depth_hist("d") is tel.depth_hist("d")
 
     def test_disabled_registry_is_noop(self):
         tel = obs.Telemetry(enabled=False)
         c, g, t = tel.counter("a"), tel.gauge("b"), tel.timer("c")
+        h = tel.depth_hist("d")
         c.add(5)
         g.set(1.0)
         t.observe(1.0)
+        h.observe(4)
         with t.time():
             pass
         tel.sample("d", lambda: 1)
         assert c.value == 0 and g.value == 0.0 and t.count == 0
+        assert h.count == 0
         assert tel.snapshot() == {}
         assert obs.NULL.snapshot() == {}
 
@@ -323,6 +365,13 @@ class TestTrainerTelemetry:
         assert counters["ingest.batches"] == 20  # 10 batches x 2 epochs
         assert counters["ingest.examples"] == 640
         assert counters["prefetch.super_batches"] == 10
+        # Queue occupancy is a per-put/get histogram now, not a
+        # heartbeat-time point sample: every queue logged its events.
+        depths = final["stages"]["depths"]
+        for q in ("ingest.work_q_depth", "ingest.out_q_depth",
+                  "prefetch.out_q_depth"):
+            assert depths[q]["count"] > 0, q
+            assert "buckets" in depths[q], q
 
         # Adopted counters ride the returned results dict too.
         tm = result["train"]

@@ -53,15 +53,37 @@ def drain_with_telemetry(pipe, tel) -> dict:
     def t(name, key):
         return timers.get(name, {}).get(key, 0.0)
 
-    return {
-        "lines_per_sec": round(snap["counters"]["ingest.examples"] / dt),
-        "batches": snap["counters"]["ingest.batches"],
+    counters = snap.get("counters", {})
+    out = {
+        "lines_per_sec": round(counters["ingest.examples"] / dt),
+        "batches": counters["ingest.batches"],
         "parse_total_s": t("ingest.parse", "total_s"),
         "parse_p50_ms": t("ingest.parse", "p50_ms"),
         "parse_p95_ms": t("ingest.parse", "p95_ms"),
         "reader_block_s": t("ingest.reader_block", "total_s"),
         "worker_out_block_s": t("ingest.out_block", "total_s"),
     }
+    # SHM-ring split (parse_processes with ring_slots > 0): how many raw
+    # windows went zero-copy vs pickled, and the descriptor bytes that
+    # actually crossed the worker queue.
+    ring = counters.get("ingest.ring_windows", 0)
+    fallback = counters.get("ingest.ring_fallback_windows", 0)
+    if ring or fallback:
+        out["ring_zero_copy_frac"] = round(ring / (ring + fallback), 4)
+        out["ring_window_mb"] = round(
+            counters.get("ingest.ring_window_bytes", 0) / 1e6, 2
+        )
+        out["queue_msg_kb"] = round(
+            counters.get("ingest.work_msg_bytes", 0) / 1e3, 2
+        )
+    # Prestacked-cache split: once-per-group stack cost at the source.
+    ps = snap.get("timers", {}).get("ingest.prestack", {})
+    if ps.get("count"):
+        out["prestack_superbatches"] = ps["count"]
+        out["stack_ms_per_superbatch"] = round(
+            1e3 * ps["total_s"] / ps["count"], 3
+        )
+    return out
 
 
 def _proc_worker(files, epochs, ready, go, out):
@@ -234,19 +256,72 @@ def main() -> int:
         # one reader, N spawned parse workers, parsed batches returning
         # over shared memory as a single trainable stream.  The rate the
         # trainer sees when the GIL (or the Python parse fallback) is
-        # the bottleneck.
+        # the bottleneck.  ring_slots toggles the INBOUND direction:
+        # 0 pickles every raw window through the worker queue, >0 writes
+        # windows into the SHM ring and ships descriptors only — the
+        # threads-vs-procs drain comparison re-run on the ring.
         for np_ in (1, 2, 4):
-            cfg = FmConfig(
-                vocabulary_size=VOCAB, factor_num=8, max_features=NFEAT,
-                batch_size=BATCH, queue_size=8, parse_processes=np_,
-            )
-            tel = obs.Telemetry()
-            pipe = BatchPipeline(
-                files, cfg, epochs=1, shuffle=True, telemetry=tel
-            )
-            stats = drain_with_telemetry(pipe, tel)
-            emit("pipeline-procpool", stats.pop("lines_per_sec"),
-                 parse_processes=np_, cores=os.cpu_count(), **stats)
+            for slots in (0, 4):
+                cfg = FmConfig(
+                    vocabulary_size=VOCAB, factor_num=8,
+                    max_features=NFEAT, batch_size=BATCH, queue_size=8,
+                    parse_processes=np_, ring_slots=slots,
+                )
+                tel = obs.Telemetry()
+                pipe = BatchPipeline(
+                    files, cfg, epochs=1, shuffle=True, telemetry=tel
+                )
+                stats = drain_with_telemetry(pipe, tel)
+                emit("pipeline-procpool", stats.pop("lines_per_sec"),
+                     parse_processes=np_, ring_slots=slots,
+                     cores=os.cpu_count(), **stats)
+
+        # Pre-stacked epoch cache (cache_prestacked): epoch 0 parses and
+        # stacks [K, ...] groups once; epoch 1 replays whole super-
+        # batches.  The two epochs are timed SEPARATELY at the in-band
+        # EpochEnd marker — the replay-epoch rate is what the trainer's
+        # transfer stage sees with its stack skipped; averaging in the
+        # epoch-0 parse would overstate it.
+        from fast_tffm_tpu.data.pipeline import EpochEnd, SuperBatch
+
+        cfg = FmConfig(
+            vocabulary_size=VOCAB, factor_num=8, max_features=NFEAT,
+            batch_size=BATCH, thread_num=2, queue_size=8,
+            cache_epochs=True, cache_prestacked=True,
+            steps_per_dispatch=8,
+        )
+        tel = obs.Telemetry()
+        pipe = BatchPipeline(
+            files, cfg, epochs=2, shuffle=True, ordered=True,
+            cache_epochs=True, cache_max_bytes=4 << 30, prestack_k=8,
+            epoch_marks=True, telemetry=tel,
+        )
+        t0 = time.perf_counter()
+        t_mark = None
+        n0 = n1 = 0
+        for b in pipe:
+            if isinstance(b, EpochEnd):
+                if b.epoch == 0:
+                    t_mark = time.perf_counter()
+                continue
+            n = int(np.count_nonzero(b.batch.weights > 0)) if isinstance(
+                b, SuperBatch) else int(np.count_nonzero(b.weights > 0))
+            if t_mark is None:
+                n0 += n
+            else:
+                n1 += n
+        t_end = time.perf_counter()
+        ps = tel.snapshot().get("timers", {}).get("ingest.prestack", {})
+        emit("pipeline-prestack",
+             n1 / max(t_end - t_mark, 1e-9),
+             note="cached REPLAY epoch only (epoch-0 parse excluded)",
+             epoch0_lines_per_sec=round(n0 / max(t_mark - t0, 1e-9)),
+             steps_per_dispatch=8,
+             prestack_superbatches=ps.get("count", 0),
+             stack_ms_per_superbatch=round(
+                 1e3 * ps.get("total_s", 0.0) / max(ps.get("count", 1), 1),
+                 3,
+             ))
 
         # Pipeline with per-batch sort_meta on the workers: what the
         # training path actually runs when host_sort engages.

@@ -6,14 +6,22 @@ a ``record`` type: run_header | train | validation | heartbeat | final);
 this tool turns one file into a human summary:
 
   python tools/report.py /path/to/metrics.jsonl
+  python tools/report.py rank0.jsonl rank1.jsonl ...   # multi-host merge
 
 Sections: the run header (config fingerprint, dispatch/ingest mode,
 platform), the train/validation progression, and the end-of-run
 wall-clock attribution — starvation (``ingest_wait_frac``) vs dispatch
-vs other, per-stage timing histograms, queue-depth gauges, and the
-data-integrity counters (truncated features, out-of-range-id batches,
-cache outcome).  Records from pre-telemetry runs (no ``record`` field)
-are classified by their keys, so old files still summarize.
+vs other, per-stage timing histograms, per-put/get queue-depth
+histograms, and the data-integrity counters (truncated features,
+out-of-range-id batches, cache outcome).  Records from pre-telemetry
+runs (no ``record`` field) are classified by their keys, so old files
+still summarize.
+
+Multi-host runs write one metrics_file per process, each tagged with
+its ``rank`` (jax.process_index) in the run header; passing several
+files merges them into one fleet view — a per-rank attribution table
+plus the full breakdown of the SLOWEST rank (the step waits for every
+host, so the fleet bottleneck is whichever rank starves hardest).
 
 Dependency-free on purpose: it must run on any box the JSONL lands on,
 jax or not.
@@ -67,8 +75,9 @@ def _fmt_rate(v: float) -> str:
 def _print_header(header: dict) -> None:
     print("run:")
     for key in (
-        "config_fingerprint", "steps_per_dispatch", "ingest_mode",
-        "fast_ingest", "cache_epochs", "batch_size", "epoch_num",
+        "rank", "config_fingerprint", "steps_per_dispatch", "ingest_mode",
+        "fast_ingest", "cache_epochs", "cache_prestacked", "ring_slots",
+        "batch_size", "epoch_num",
         "optimizer", "backend", "jax_version", "mesh", "telemetry",
         "heartbeat_secs", "resume_step", "resume_epoch", "resume_skip",
     ):
@@ -144,20 +153,97 @@ def _print_breakdown(rec: dict) -> None:
         print("\ncounters:")
         for name in sorted(counters):
             print(f"  {name:24} {counters[name]}")
+    depths = stages.get("depths") or {}
+    depths = {k: d for k, d in depths.items() if d.get("count")}
+    if depths:
+        print("\nqueue depths (per put/get histogram):")
+        print(f"  {'queue':24} {'events':>8} {'mean':>6} {'max':>5}  "
+              f"occupancy")
+        for name in sorted(depths):
+            d = depths[name]
+            buckets = " ".join(
+                f"{k}:{v}" for k, v in (d.get("buckets") or {}).items()
+            )
+            print(
+                f"  {name:24} {d['count']:>8} {d.get('mean', 0):>6} "
+                f"{d.get('max', 0):>5}  {buckets}"
+            )
+
+
+def _stream_rank(groups: dict, fallback: int) -> int:
+    headers = groups.get("run_header", [])
+    if headers and "rank" in headers[-1]:
+        return int(headers[-1]["rank"])
+    return fallback
+
+
+def _merge_ranks(streams: list) -> int:
+    """Fleet view over per-rank metrics files: a rank attribution table
+    + the slowest rank's full breakdown."""
+    rows = []
+    for path, groups in streams:
+        rank = _stream_rank(groups, len(rows))
+        final = (groups.get("final") or groups.get("heartbeat") or [None])
+        rows.append((rank, path, groups, final[-1]))
+    rows.sort(key=lambda r: r[0])
+    print(f"merged {len(rows)} rank streams: "
+          f"{', '.join(str(r[0]) for r in rows)}")
+    headers = rows[0][2].get("run_header", [])
+    if headers:
+        _print_header(headers[-1])
+        fps = {
+            (r[2].get("run_header") or [{}])[-1].get("config_fingerprint")
+            for r in rows
+        }
+        if len(fps) > 1:
+            print("  ! config fingerprints DIFFER across ranks:", fps)
+    print("\nper-rank attribution:")
+    print(f"  {'rank':>4} {'step':>8} {'elapsed':>9} {'wait_frac':>9} "
+          f"{'examples_in':>12}  verdict")
+    slowest = None
+    for rank, path, groups, final in rows:
+        if final is None:
+            print(f"  {rank:>4} {'?':>8} {'?':>9} {'?':>9} {'?':>12}  "
+                  f"no final/heartbeat record ({path})")
+            continue
+        frac = final.get("ingest_wait_frac", 0.0)
+        verdict = "ingest-bound" if frac > 0.25 else "compute-bound"
+        print(
+            f"  {rank:>4} {final.get('step', 0):>8} "
+            f"{final.get('elapsed', 0.0):>9.1f} {frac:>9.3f} "
+            f"{final.get('examples_in', 0):>12}  {verdict}"
+        )
+        if slowest is None or frac > slowest[1].get("ingest_wait_frac", 0):
+            slowest = (rank, final)
+    if slowest is not None:
+        print(f"\nslowest rank: {slowest[0]} (the step waits for every "
+              f"host — this rank sets the fleet's pace)")
+        _print_breakdown(slowest[1])
+    return 0
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="summarize a fast_tffm_tpu metrics/telemetry JSONL"
     )
-    ap.add_argument("path", help="metrics_file JSONL written by a run")
+    ap.add_argument("paths", nargs="+",
+                    help="metrics_file JSONL(s) written by a run; pass "
+                         "one per rank to merge a multi-host fleet")
     ap.add_argument("--limit", type=int, default=8,
                     help="train/validation rows to show (default 8)")
     args = ap.parse_args(argv)
-    groups = load(args.path)
-    if not groups:
-        print(f"{args.path}: no records")
+    streams = []
+    for path in args.paths:
+        groups = load(path)
+        if groups:
+            streams.append((path, groups))
+        else:
+            print(f"{path}: no records")
+    if not streams:
         return 1
+    if len(streams) > 1:
+        return _merge_ranks(streams)
+    groups = streams[0][1]
     headers = groups.get("run_header", [])
     if headers:
         _print_header(headers[-1])
