@@ -339,7 +339,8 @@ def _bench_parse_only(files, cfg) -> float:
 
 
 def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
-               k: int = 1, telemetry_enabled: bool = True) -> tuple:
+               k: int = 1, telemetry_enabled: bool = True,
+               tracer=None) -> tuple:
     """Examples/sec through BatchPipeline + DevicePrefetcher — the
     train() hot path: parse threads, the stacking/H2D transfer thread,
     and the K-step fused dispatch all overlapped.  ``warmup`` counts
@@ -364,6 +365,11 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     measured here instead of re-derived with bench-local stopwatches.
     With ``telemetry_enabled=False`` the run uses no-op instruments
     (the on/off rate ratio is the layer's measured overhead).
+
+    ``tracer`` (an enabled obs.Tracer) additionally records the causal
+    span layer through the pipeline + prefetcher + this loop's
+    wait/dispatch — the trace-overhead probe runs the identical e2e
+    with it attached and compares rates.
     """
     from fast_tffm_tpu import obs
     from fast_tffm_tpu.data.pipeline import (
@@ -371,6 +377,7 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     )
 
     tel = obs.Telemetry(enabled=telemetry_enabled)
+    tracer = tracer if tracer is not None else obs.NULL_TRACER
     t_wait = tel.timer("train.wait_input")
     t_disp = tel.timer("train.dispatch")
     # The dataset (not epochs) bounds the cache: size the budget to hold
@@ -386,6 +393,7 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
         # trainer's cache_prestacked path).
         prestack_k=k,
         telemetry=tel,
+        tracer=tracer,
     )
 
     # Real-example counts ride the host stack (transfer thread), keeping
@@ -401,17 +409,24 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
         # put() device_puts (copies out of host memory), so stacking can
         # recycle the pre-allocated staging buffers like the trainer.
         staging=True,
+        tracer=tracer,
     )
     it = iter(prefetcher)
     epoch_rates: dict[int, float] = {}
     try:
         warmed = 0
+        # sb label counts from the first super-batch CONSUMED, warmup
+        # included, so the trace's train.dispatch args.sb stays aligned
+        # with the prefetcher's stack/h2d sb ids (trace_chains joins on
+        # it).
+        sb_i = 0
         while warmed < warmup:
             item = next(it)
             if isinstance(item, EpochEnd):  # tiny stream: epoch < warmup
                 continue
             (sb, _), kk = item
             trainer.state = trainer._scan_train_step(trainer.state, sb)
+            sb_i += 1
             warmed += kk
         _drain(trainer.state)
         n = 0
@@ -421,7 +436,7 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
         t0 = time.perf_counter()
         n_mark, t_mark = 0, t0
         while True:
-            with t_wait.time():
+            with t_wait.time(), tracer.span("train.wait_input"):
                 item = next(it, None)
             if item is None:
                 break
@@ -435,8 +450,11 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
                 n_mark, t_mark = n, now
                 continue
             (sb, n_real), kk = item
-            with t_disp.time():
+            with t_disp.time(), tracer.span(
+                "train.dispatch", args={"sb": sb_i, "k": kk}
+            ):
                 trainer.state = trainer._scan_train_step(trainer.state, sb)
+            sb_i += 1
             n += n_real
         _drain(trainer.state)
         dt = time.perf_counter() - t0
@@ -517,6 +535,26 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=50)
     args = ap.parse_args()
 
+    # Preflight: tier-1 marker audit (tools/check_tier1.py, static AST —
+    # milliseconds).  A test file whose every test went slow has silently
+    # dropped out of the correctness gate; the bench JSON records that
+    # drift every run so it can't pass unnoticed.
+    tier1_audit = None
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        import check_tier1
+
+        a = check_tier1.audit(os.path.join(repo, "tests"), repo)
+        tier1_audit = {
+            "ok": a["ok"], "files": a["files"], "tier1": a["tier1"],
+            "slow": a["slow"],
+        }
+        if a["problems"]:
+            tier1_audit["problems"] = a["problems"][:5]
+    except Exception as e:  # noqa: BLE001 - preflight must not sink bench
+        tier1_audit = {"ok": False, "problems": [f"audit failed: {e}"]}
+
     watchdog_note = None
     if not os.environ.get("BENCH_CHILD") and not os.environ.get(
         "BENCH_FORCE_CPU"
@@ -556,6 +594,7 @@ def main() -> int:
     ingest_cache = "off"
     tele_report = None
     e2e_tel_off = 0.0
+    e2e_trace_on, trace_events = 0.0, 0
     bf16_rung, bf16_errors = None, []
     e2e_err = None
     cfg = None
@@ -702,6 +741,24 @@ def main() -> int:
                     e2e_rate_k1, _, _, _, _ = _bench_e2e(
                         trainer, cfg, files, warmup=4, epochs=epochs, k=1
                     )
+                    # Trace-overhead probe (telemetry_on_vs_off-style):
+                    # the identical K=8 e2e with the causal span layer
+                    # recording through pipeline + prefetcher + the
+                    # dispatch loop.  trace_overhead = off/on rate
+                    # ratio; the span layer's budget is <= 1.05.
+                    try:
+                        from fast_tffm_tpu import obs as _obs
+
+                        _tr = _obs.Tracer(enabled=True)
+                        e2e_trace_on, _, _, _, _ = _bench_e2e(
+                            trainer, cfg, files, warmup=4,
+                            epochs=epochs, k=K, tracer=_tr,
+                        )
+                        trace_events = len(_tr.take())
+                    except Exception as e:  # noqa: BLE001 - report only
+                        ladder_errors.append(
+                            f"trace probe: {type(e).__name__}: {e}"
+                        )
                     # parse_processes scaling: drain the bare pipeline
                     # with thread workers vs a spawned process pool on
                     # the same files (no training attached).
@@ -803,6 +860,14 @@ def main() -> int:
         "telemetry_on_vs_off": round(
             e2e_rate / e2e_tel_off, 4
         ) if e2e_tel_off > 0 and e2e_rate > 0 else 0.0,
+        # Trace overhead: the same K=8 e2e with the causal span layer
+        # recording (pipeline/prefetcher/dispatch spans).  off/on rate
+        # ratio; budget <= 1.05 (box noise is ±3%, so ~1.0 = free).
+        "e2e_trace_on_examples_per_sec": round(e2e_trace_on, 1),
+        "trace_overhead": round(
+            e2e_rate / e2e_trace_on, 4
+        ) if e2e_trace_on > 0 and e2e_rate > 0 else 0.0,
+        "trace_events_recorded": trace_events,
         "parse_lines_per_sec": round(parse_rate, 1),
         # Bare-pipeline drain rates: thread workers vs a spawned
         # parse-process pool on the same files (GIL-free scaling probe).
@@ -838,6 +903,8 @@ def main() -> int:
             "stack_ms_per_superbatch", 0.0
         )
         result["telemetry"] = tele_report
+    if tier1_audit is not None:
+        result["tier1_audit"] = tier1_audit
     if ladder_rung is not None:
         result["ladder_rung"] = ladder_rung
     if ladder_errors:

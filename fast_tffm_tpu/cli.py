@@ -97,6 +97,19 @@ def build_argparser() -> argparse.ArgumentParser:
         help="disable the run-wide telemetry layer entirely (no-op "
              "instruments; heartbeats report nothing)",
     )
+    p.add_argument(
+        "--trace", nargs="?", const="./tffm_trace.json", default=None,
+        metavar="PATH", dest="trace_file",
+        help="record a Chrome-trace (Perfetto-loadable) span file of "
+             "every pipeline stage, correlated per batch/super-batch "
+             "(default path ./tffm_trace.json; merge multi-rank files "
+             "with tools/report.py --trace)",
+    )
+    p.add_argument(
+        "--nan_policy", choices=["warn", "halt"], default=None,
+        help="on a non-finite (NaN/inf) gradient: warn and keep "
+             "counting, or halt without overwriting the checkpoint",
+    )
     # Legacy reference flags (mapped, SURVEY.md §3.2).
     p.add_argument("--ps_hosts", default=None, help="legacy; ps tasks exit")
     p.add_argument("--worker_hosts", default=None,
@@ -143,7 +156,8 @@ def main(argv=None) -> int:
         key: getattr(args, key)
         for key in ("steps_per_dispatch", "prefetch_super_batches",
                     "parse_processes", "cache_epochs", "cache_max_bytes",
-                    "cache_prestacked", "ring_slots", "heartbeat_secs")
+                    "cache_prestacked", "ring_slots", "heartbeat_secs",
+                    "trace_file", "nan_policy")
         if getattr(args, key) is not None
     }
     if args.no_telemetry:

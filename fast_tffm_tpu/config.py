@@ -147,6 +147,23 @@ class FmConfig:
     # with the telemetry snapshot + ingest_wait_frac, and logs a
     # one-line summary — any run self-reports its bottleneck.  0 = off.
     heartbeat_secs: float = 0.0
+    # Causal batch tracing: write a Chrome-trace-format (Perfetto-
+    # loadable) span file here — per-window read, SHM ring slot
+    # acquire/release, per-batch parse (thread AND process workers),
+    # prefetcher stack / staging-wait / H2D, and train-loop wait/
+    # dispatch, all correlated by batch/super-batch id so one super-
+    # batch's life is a connected chain from file read to fused-scan
+    # dispatch.  Empty = off (no-op tracer; bit-identical training).
+    # Multi-host ranks > 0 suffix the path with .rankN; merge with
+    # `python tools/report.py --trace <files>`.
+    trace_file: str = ""
+    # What to do when a dispatch produces a non-finite (NaN/inf)
+    # gradient (detected on-device by the scan-carry health monitors,
+    # checked one dispatch delayed so detection costs no pipeline
+    # bubble): "warn" logs once and keeps counting (the final record
+    # carries the totals); "halt" raises NonFiniteGradError without
+    # overwriting the checkpoint with poisoned params.
+    nan_policy: str = "warn"
 
     # --- [Tpu] (new; not in reference) ---
     # Max features per example; batches are padded to this static shape.
@@ -253,6 +270,8 @@ class FmConfig:
             raise ValueError(
                 f"heartbeat_secs must be >= 0, got {self.heartbeat_secs}"
             )
+        if self.nan_policy not in ("warn", "halt"):
+            raise ValueError(f"unknown nan_policy {self.nan_policy!r}")
         if self.cache_max_bytes <= 0:
             raise ValueError(
                 f"cache_max_bytes must be positive, got {self.cache_max_bytes}"
@@ -340,6 +359,8 @@ _KEYMAP = {
     "metrics_file": ("metrics_file", str),
     "telemetry": ("telemetry", _parse_bool),
     "heartbeat_secs": ("heartbeat_secs", float),
+    "trace_file": ("trace_file", str),
+    "nan_policy": ("nan_policy", str),
     "max_features": ("max_features", int),
     "mesh_data": ("mesh_data", int),
     "mesh_model": ("mesh_model", int),

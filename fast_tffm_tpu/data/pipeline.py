@@ -402,6 +402,7 @@ class BatchPipeline:
         prestack_k: int = 0,
         epoch_marks: bool = False,
         telemetry: Optional[obs.Telemetry] = None,
+        tracer: Optional[obs.Tracer] = None,
     ):
         self.files = list(files)
         # Telemetry instruments (obs.NULL when not passed: every call
@@ -409,6 +410,18 @@ class BatchPipeline:
         # naming: ingest.* covers reader + parse workers + delivery.
         self.telemetry = telemetry if telemetry is not None else obs.NULL
         tel = self.telemetry
+        # Causal batch tracing (obs.NULL_TRACER = no-op): spans per read
+        # window / ring-slot acquire / parse, plus an ``ingest.deliver``
+        # point at the single delivery exit that bridges the reader's
+        # work-item ``seq`` to the delivered ``batch`` index — the join
+        # key the prefetcher's super-batch grouping continues from.
+        self.tracer = tracer if tracer is not None else obs.NULL_TRACER
+        # seq of the batch most recently yielded by the streaming core
+        # (generator chains are synchronous, so at the __iter__ exit this
+        # names exactly the item that just bubbled up); None for cached
+        # replays, which have no fresh parse to correlate with.
+        self._last_seq: Optional[int] = None
+        self._deliver_idx = 0
         self._c_batches = tel.counter("ingest.batches")
         self._c_examples = tel.counter("ingest.examples")
         self._c_cache_replays = tel.counter("ingest.cache_replay_batches")
@@ -539,6 +552,7 @@ class BatchPipeline:
         # "disabled" must mean no per-batch work at all, or the bench's
         # on/off overhead probe compares against a lie.
         counting = self.telemetry.enabled
+        tracing = self.tracer.enabled
         for item in inner:
             if isinstance(item, SuperBatch):
                 self._c_batches.add(item.n)
@@ -546,12 +560,24 @@ class BatchPipeline:
                     self._c_examples.add(
                         int(np.count_nonzero(item.batch.weights > 0))
                     )
+                if tracing:
+                    self.tracer.point("ingest.deliver", args={
+                        "batch": self._deliver_idx, "n": item.n,
+                        "seq": self._last_seq,
+                    })
+                self._deliver_idx += item.n
             elif not isinstance(item, EpochEnd):
                 self._c_batches.add(1)
                 if counting:
                     self._c_examples.add(
                         int(np.count_nonzero(item.weights > 0))
                     )
+                if tracing:
+                    self.tracer.point("ingest.deliver", args={
+                        "batch": self._deliver_idx, "n": 1,
+                        "seq": self._last_seq,
+                    })
+                self._deliver_idx += 1
             yield item
 
     def _emit_stream(self, n_epochs: int, first_epoch: int, skip: int):
@@ -615,6 +641,7 @@ class BatchPipeline:
                 yield from self._emit_stream(E - e0, e0, skip)
             return
         epoch0_trunc = self.truncated_features - trunc_start
+        self._last_seq = None  # replays have no fresh parse to trace
         for epoch in range(max(1, e0), E):
             order = list(range(len(cache)))
             if self.shuffle:
@@ -728,6 +755,7 @@ class BatchPipeline:
                 yield from self._emit_stream(E - e0, e0, skip)
             return
         epoch0_trunc = self.truncated_features - trunc_start
+        self._last_seq = None  # replays have no fresh parse to trace
         for epoch in range(max(1, e0), E):
             order = list(range(len(cache)))
             if self.shuffle:
@@ -822,6 +850,28 @@ class BatchPipeline:
             yield seq, EpochEnd(epoch)
             seq += 1
 
+    def _traced_items(self, it):
+        """Wrap the reader's work-item stream with ``read.item`` spans:
+        each span covers the time to PRODUCE one item (file read, window
+        scan, shuffle) — generator chains run synchronously, so nothing
+        else can hide inside it.  No-op (plain passthrough) when tracing
+        is off."""
+        tracer = self.tracer
+        if not tracer.enabled:
+            yield from it
+            return
+        tracer.name_thread("ingest-reader")
+        while True:
+            t0 = time.perf_counter()
+            nxt = next(it, None)
+            if nxt is None:
+                return
+            seq, item = nxt
+            if not isinstance(item, EpochEnd):
+                tracer.emit("read.item", t0, time.perf_counter() - t0,
+                            args={"seq": seq})
+            yield seq, item
+
     def _iter_stream(
         self, n_epochs: int, first_epoch: int = 0, skip: int = 0
     ) -> Iterator:
@@ -886,11 +936,15 @@ class BatchPipeline:
         )
         n_workers = max(1, cfg.thread_num)
 
+        tracer = self.tracer
+        tracing = tracer.enabled
+        timed = self.telemetry.enabled or tracing
+
         def reader():
             try:
-                for seq, item in self._epoch_items(
+                for seq, item in self._traced_items(self._epoch_items(
                     n_epochs, first_epoch, skip
-                ):
+                )):
                     # Producer-block time: how long the reader waits for
                     # a work-queue slot.  Large totals mean parsing (not
                     # reading) limits ingest.
@@ -907,6 +961,8 @@ class BatchPipeline:
                         break
 
         def parse_worker():
+            if tracing:
+                tracer.name_thread("parse-worker")
             while True:
                 got = work.get()
                 if got is _CANCELLED:
@@ -919,17 +975,28 @@ class BatchPipeline:
                     out.put((seq, chunk))
                     continue
                 try:
-                    with self._t_parse.time():
-                        if isinstance(chunk, tuple):  # raw (buf,starts,ends)
-                            batch = self._native.parse_raw(
-                                chunk[0], chunk[1], chunk[2], cfg.batch_size
-                            )
-                        else:
-                            lines = [c[0] for c in chunk]
-                            weights = [c[1] for c in chunk]
-                            batch = self._parser(lines, weights)
-                        if self._sort_meta_spec is not None:
-                            batch = self._attach_meta(batch)
+                    # Per-batch timing only when someone consumes it:
+                    # "disabled" must mean no per-batch work at all, or
+                    # the bench's on/off overhead probes compare
+                    # against a lie (same invariant as delivery
+                    # counting above).
+                    t0p = time.perf_counter() if timed else 0.0
+                    if isinstance(chunk, tuple):  # raw (buf,starts,ends)
+                        batch = self._native.parse_raw(
+                            chunk[0], chunk[1], chunk[2], cfg.batch_size
+                        )
+                    else:
+                        lines = [c[0] for c in chunk]
+                        weights = [c[1] for c in chunk]
+                        batch = self._parser(lines, weights)
+                    if self._sort_meta_spec is not None:
+                        batch = self._attach_meta(batch)
+                    if timed:
+                        dtp = time.perf_counter() - t0p
+                        self._t_parse.observe(dtp)
+                        if tracing:
+                            tracer.emit("parse.batch", t0p, dtp,
+                                        args={"seq": seq})
                 except BaseException as e:
                     out.put(_Error(e))
                     continue
@@ -961,6 +1028,7 @@ class BatchPipeline:
                     raise item.exc
                 seq, obj = item
                 if not self.ordered:
+                    self._last_seq = seq
                     yield obj
                     continue
                 # Reorder by sequence number: parsing is parallel but
@@ -968,11 +1036,13 @@ class BatchPipeline:
                 # items: work queue + workers + out queue).
                 held[seq] = obj
                 while next_seq in held:
+                    self._last_seq = next_seq
                     yield held.pop(next_seq)
                     next_seq += 1
             # Workers exited; whatever is held is contiguous from
             # next_seq (an error would have raised above).
             for seq in sorted(held):
+                self._last_seq = seq
                 yield held[seq]
         finally:
             # Deterministic shutdown: cancel wakes every blocked put/get
@@ -1047,6 +1117,7 @@ class BatchPipeline:
             ring_name=ring.name if ring is not None else None,
             ring_slots=cfg.ring_slots,
             ring_slot_bytes=ring.slot_bytes if ring is not None else 0,
+            trace=self.tracer.enabled,
         )
         procs = [
             ctx.Process(
@@ -1077,6 +1148,7 @@ class BatchPipeline:
         # whole point of the ring is that work messages shrink to slot
         # descriptors, and the counter is what proves it (tier-1 test).
         counting = self.telemetry.enabled
+        tracer = self.tracer
 
         reader_err: list = []
 
@@ -1109,9 +1181,19 @@ class BatchPipeline:
                     <= ring.slot_bytes
                 ):
                     observe_depth(h_ring, ring_free)
+                    # Slot-acquire wait: all slots in flight = the ring's
+                    # backpressure; a long span here means parse workers
+                    # (not the reader) limit ingest.
+                    t0s = time.perf_counter()
                     slot = procpool.get_with_stop(ring_free, stop)
                     if slot is None:
                         return False
+                    if tracer.enabled:
+                        tracer.emit(
+                            "ring.slot_acquire", t0s,
+                            time.perf_counter() - t0s,
+                            args={"slot": slot, "seq": seq0},
+                        )
                     ring.write(
                         slot, buf,
                         np.concatenate(starts_list),
@@ -1131,9 +1213,9 @@ class BatchPipeline:
                 )
 
             try:
-                for seq, item in self._epoch_items(
+                for seq, item in self._traced_items(self._epoch_items(
                     n_epochs, first_epoch, skip
-                ):
+                )):
                     if isinstance(item, EpochEnd):
                         if not flush():
                             return
@@ -1189,29 +1271,38 @@ class BatchPipeline:
                 kind = msg[0]
                 if kind == "done":
                     expect_done -= 1
+                    # Trailing span shipment: worker events that ended
+                    # after its last batch (e.g. the final window span).
+                    if len(msg) > 1:
+                        tracer.add_raw(msg[1])
                     continue
                 if kind == "err":
                     raise msg[1]
                 if kind == "mark":
                     seq, obj = msg[1], EpochEnd(msg[2])
-                else:  # ("batch", seq, shm, has_meta, trunc, note, parse_s)
+                else:  # ("batch", seq, shm, meta, trunc, note, t, spans)
                     seq = msg[1]
                     obj = procpool.attach_batch(spec, msg[2], msg[3])
                     self._trunc_extra += msg[4]
                     self._log_worker_note(msg[5])
                     # Workers can't reach this process's registry; they
-                    # ship their parse wall time with each batch instead.
+                    # ship their parse wall time with each batch instead
+                    # — and their trace spans the same way.
                     self._t_parse.observe(msg[6])
+                    tracer.add_raw(msg[7])
                 if not self.ordered:
+                    self._last_seq = seq
                     yield obj
                     continue
                 held[seq] = obj
                 while next_seq in held:
+                    self._last_seq = next_seq
                     yield held.pop(next_seq)
                     next_seq += 1
             if reader_err:
                 raise reader_err.pop()
             for seq in sorted(held):
+                self._last_seq = seq
                 yield held[seq]
         finally:
             stop.set()
@@ -1351,7 +1442,7 @@ class _StagingPool:
     K' < K get their own small slot.
     """
 
-    def __init__(self, limit: int, reuse_counter=None):
+    def __init__(self, limit: int, reuse_counter=None, tracer=None):
         self._free: dict = {}  # key -> [Batch bufset, ...]
         self._inflight: deque = deque()  # (dev, key, bufset)
         self._limit = max(1, limit)
@@ -1359,6 +1450,7 @@ class _StagingPool:
             reuse_counter if reuse_counter is not None
             else obs.NULL.counter("")
         )
+        self._tracer = tracer if tracer is not None else obs.NULL_TRACER
 
     @staticmethod
     def _key(group):
@@ -1400,10 +1492,18 @@ class _StagingPool:
 
     def acquire(self, group) -> libsvm.Batch:
         key = self._key(group)
-        while len(self._inflight) >= self._limit:
-            dev, k2, bufs = self._inflight.popleft()
-            self._wait(dev)
-            self._free.setdefault(k2, []).append(bufs)
+        if len(self._inflight) >= self._limit:
+            # Block-on-oldest-transfer before recycling: the span makes
+            # the ROADMAP question "is the prefetcher thread blocked on
+            # staging reuse?" directly visible in a trace.
+            with self._tracer.span(
+                "prefetch.staging_wait",
+                args={"inflight": len(self._inflight)},
+            ):
+                while len(self._inflight) >= self._limit:
+                    dev, k2, bufs = self._inflight.popleft()
+                    self._wait(dev)
+                    self._free.setdefault(k2, []).append(bufs)
         free = self._free.get(key)
         if free:
             self._c_reuse.add(1)
@@ -1445,7 +1545,8 @@ class DevicePrefetcher:
 
     def __init__(self, source, steps_per_dispatch: int, put_fn,
                  depth: int = 2, telemetry: Optional[obs.Telemetry] = None,
-                 staging: bool = False):
+                 staging: bool = False,
+                 tracer: Optional[obs.Tracer] = None):
         self._k = max(1, steps_per_dispatch)
         self._put_fn = put_fn
         # Transfer-stage instruments: stack vs H2D vs output-block time.
@@ -1460,6 +1561,15 @@ class DevicePrefetcher:
         self._t_out_block = tel.timer("prefetch.out_block")
         self._c_super = tel.counter("prefetch.super_batches")
         self._c_prestack = tel.counter("prefetch.prestack_hits")
+        # Trace correlation: this stage ASSIGNS the super-batch id (sb
+        # = emission order, which the bounded FIFO output queue carries
+        # unchanged to the consumer, so the train loop's own dispatch
+        # counter names the same super-batch) and carries the delivered
+        # batch index forward (counted here in source order — the same
+        # order the pipeline's ``ingest.deliver`` points counted).
+        self._tracer = tracer if tracer is not None else obs.NULL_TRACER
+        self._sb_id = 0
+        self._batch_idx = 0
         # Staging-buffer reuse is opt-in: it requires put_fn to COPY out
         # of the host arrays (device_put does; an identity put_fn used
         # by tests/bench drains hands the arrays downstream, where a
@@ -1468,6 +1578,7 @@ class DevicePrefetcher:
             _StagingPool(
                 max(1, depth) + 1,
                 reuse_counter=tel.counter("prefetch.staging_reuse"),
+                tracer=self._tracer,
             )
             if staging else None
         )
@@ -1478,6 +1589,7 @@ class DevicePrefetcher:
 
     def _run(self, it):
         try:
+            self._tracer.name_thread("prefetch")
             group: list = []
             while True:
                 batch = next(it, _SENTINEL)
@@ -1525,14 +1637,26 @@ class DevicePrefetcher:
                     pass
 
     def _emit(self, group) -> bool:
+        sb_id, batch0 = self._sb_id, self._batch_idx
+        self._sb_id += 1
+        self._batch_idx += len(group)
         bufs = None
-        with self._t_stack.time(), obs.trace_span("tffm:stack"):
+        with self._t_stack.time(), obs.trace_span("tffm:stack"), \
+                self._tracer.span(
+                    "prefetch.stack",
+                    args={"sb": sb_id, "batch0": batch0, "n": len(group)},
+                    flow=("s", f"sb{sb_id}"),
+                ):
             if self._pool is not None and len(group) > 1:
                 bufs = self._pool.acquire(group)
                 stacked = stack_batches(group, out=bufs)
             else:
                 stacked = stack_batches(group)
-        with self._t_put.time(), obs.trace_span("tffm:h2d"):
+        with self._t_put.time(), obs.trace_span("tffm:h2d"), \
+                self._tracer.span(
+                    "prefetch.h2d", args={"sb": sb_id},
+                    flow=("t", f"sb{sb_id}"),
+                ):
             dev = self._put_fn(stacked)
         if bufs is not None:
             self._pool.retire(dev, group, bufs)
@@ -1544,7 +1668,16 @@ class DevicePrefetcher:
 
     def _emit_prestacked(self, sb: SuperBatch) -> bool:
         """Ship an already-stacked group: zero stacking work, one put."""
-        with self._t_put.time(), obs.trace_span("tffm:h2d"):
+        sb_id, batch0 = self._sb_id, self._batch_idx
+        self._sb_id += 1
+        self._batch_idx += sb.n
+        with self._t_put.time(), obs.trace_span("tffm:h2d"), \
+                self._tracer.span(
+                    "prefetch.h2d",
+                    args={"sb": sb_id, "batch0": batch0, "n": sb.n,
+                          "prestacked": True},
+                    flow=("s", f"sb{sb_id}"),
+                ):
             dev = self._put_fn(sb.batch)
         self._c_super.add(1)
         self._c_prestack.add(1)

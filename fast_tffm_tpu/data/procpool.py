@@ -85,6 +85,10 @@ class WorkerSpec:
     ring_name: Optional[str] = None  # inbound ShmRing segment (None = off)
     ring_slots: int = 0
     ring_slot_bytes: int = 0
+    # Record per-batch/per-window trace spans (obs.Tracer) in the worker
+    # and ship them back with each result message; the parent merges
+    # them into the run's trace file under this worker's pid lane.
+    trace: bool = False
 
 
 _CORE = ("labels", "ids", "vals", "fields", "weights")
@@ -470,15 +474,28 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
       None                                          — shutdown sentinel.
 
     Result messages:
-      ("batch", seq, shm_name, has_meta, trunc_delta, note, parse_s)
-      ("mark", seq, epoch) | ("err", exc) | ("done",)
+      ("batch", seq, shm_name, has_meta, trunc_delta, note, parse_s,
+       spans)
+      ("mark", seq, epoch) | ("err", exc) | ("done", spans)
 
     ``parse_s`` is this batch's parse+prep wall time in the worker — a
     spawned process cannot write to the parent's telemetry registry, so
     the duration rides the result message and the parent observes it
-    into the shared ``ingest.parse`` timer.
+    into the shared ``ingest.parse`` timer.  ``spans`` works the same
+    way for the trace layer: with ``spec.trace`` the worker records
+    Chrome-trace events (``parse.batch`` per batch, ``parse.window`` per
+    ring window — its end marks the slot release) into a local
+    obs.Tracer and ships the accumulated raw events with each result;
+    the parent merges them into the run's trace under this worker's pid.
+    The trailing ``("done", spans)`` flushes spans that ended after the
+    last batch shipped (the final window span).
     """
     parse_lines, parse_raw, trunc = _build_parser(spec)
+    from fast_tffm_tpu.obs.trace import Tracer
+
+    tracer = Tracer(
+        enabled=spec.trace, process_name=f"parse-worker {os.getpid()}"
+    )
     meta_spec = spec.sort_meta_spec
     ring = None
     if spec.ring_name is not None:
@@ -512,7 +529,7 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
             parse_s += time.perf_counter() - t0
         shm_name = ship_batch(spec, batch, has_meta)
         if put(("batch", seq, shm_name, has_meta, trunc_delta, note,
-                parse_s)):
+                parse_s, tracer.take())):
             return True
         # Teardown raced the ship: the segment is already unregistered
         # from this worker's tracker and nobody will ever attach it —
@@ -526,7 +543,7 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
         except _queue.Empty:
             continue
         if msg is None:
-            put(("done",))
+            put(("done", tracer.take()))
             return
         try:
             kind = msg[0]
@@ -539,6 +556,7 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
                 # ring slot, then hand the slot back for reuse.
                 _, seq0, slot, text_len, sizes = msg
                 buf, starts, ends = ring.read(slot, text_len, sum(sizes))
+                t_w0 = time.perf_counter()
                 try:
                     pos = 0
                     for j, n in enumerate(sizes):
@@ -548,12 +566,22 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
                             buf, starts[pos:pos + n], ends[pos:pos + n]
                         )
                         dt = time.perf_counter() - t0
+                        tracer.emit("parse.batch", t0, dt,
+                                    args={"seq": seq0 + j})
                         pos += n
                         if not emit(batch, seq0 + j, trunc() - before, dt):
                             return
                 finally:
                     del buf, starts, ends  # drop the slot's buffer exports
                     ring_free.put(slot)
+                    # The window span closes at slot release: its end IS
+                    # the moment the slot went back on the free queue.
+                    tracer.emit(
+                        "parse.window", t_w0,
+                        time.perf_counter() - t_w0,
+                        args={"slot": slot, "seq0": seq0,
+                              "n_batches": len(sizes)},
+                    )
             elif kind == "raw":
                 _, seq0, buf, starts_list, ends_list = msg
                 for j, (s, e) in enumerate(zip(starts_list, ends_list)):
@@ -561,6 +589,8 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
                     t0 = time.perf_counter()
                     batch = parse_raw(buf, s, e)
                     dt = time.perf_counter() - t0
+                    tracer.emit("parse.batch", t0, dt,
+                                args={"seq": seq0 + j})
                     if not emit(batch, seq0 + j, trunc() - before, dt):
                         return
             else:  # lines
@@ -569,6 +599,7 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
                 t0 = time.perf_counter()
                 batch = parse_lines(lines, weights)
                 dt = time.perf_counter() - t0
+                tracer.emit("parse.batch", t0, dt, args={"seq": seq})
                 if not emit(batch, seq, trunc() - before, dt):
                     return
         except BaseException as e:

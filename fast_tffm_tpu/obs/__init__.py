@@ -1,20 +1,23 @@
-"""Observability layer: run-wide telemetry + structured heartbeat.
+"""Observability layer: telemetry, heartbeat, and causal batch tracing.
 
 ``obs.Telemetry`` is the shared instrument registry (counters, gauges,
 ring-buffer timings) every pipeline stage writes into; ``obs.NULL`` is
 the always-safe disabled registry; ``obs.trace_span`` names host phases
 in xprof traces; ``obs.Heartbeat``/``obs.JsonlWriter`` turn a running
-train into a self-reporting JSONL stream.  See telemetry.py for the
-design constraints (thread-safety, near-zero hot-path overhead, no jax
-or numpy imports).
+train into a self-reporting JSONL stream; ``obs.Tracer`` /
+``obs.NULL_TRACER`` record Chrome-trace (Perfetto-loadable) spans from
+every stage, correlated per batch/super-batch (trace.py).  See
+telemetry.py for the shared design constraints (thread-safety,
+near-zero hot-path overhead, no jax or numpy imports).
 """
 
 from fast_tffm_tpu.obs.heartbeat import Heartbeat, JsonlWriter
 from fast_tffm_tpu.obs.telemetry import (
     NULL, Counter, DepthHist, Gauge, Telemetry, Timing, trace_span,
 )
+from fast_tffm_tpu.obs.trace import NULL_TRACER, Tracer
 
 __all__ = [
     "Counter", "Gauge", "Timing", "DepthHist", "Telemetry", "NULL",
-    "trace_span", "Heartbeat", "JsonlWriter",
+    "trace_span", "Heartbeat", "JsonlWriter", "Tracer", "NULL_TRACER",
 ]

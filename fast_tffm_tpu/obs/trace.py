@@ -1,0 +1,272 @@
+"""Structured per-batch event tracing in Chrome-trace (Perfetto) format.
+
+The telemetry layer (telemetry.py) answers "where did the run's
+wall-clock go IN AGGREGATE" — p50/p95 timers, wait-vs-dispatch totals.
+It cannot answer CAUSAL questions: which stage did THIS slow super-batch
+stall in, was the prefetcher thread blocked on staging-buffer reuse, did
+the parse workers sit idle while the reader rebuilt a window?  Those
+need per-event spans ordered on a timeline.  This module is that layer:
+a low-overhead structured tracer whose output loads directly into
+Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+
+Design constraints, shared with telemetry.py:
+
+- stdlib only (no jax, no numpy): spawned parse workers run a
+  :class:`Tracer` of their own and ship their events back over the
+  existing result messages, so this module must import clean in a
+  process that never loads jax;
+- one shared no-op instance per disabled tracer (:data:`NULL_TRACER`):
+  instrumented code never branches — ``tracer.span(...)`` on a disabled
+  tracer returns a cached null context manager, and ``emit`` returns
+  immediately;
+- enabled overhead is two ``perf_counter`` calls plus one lock-guarded
+  list append per span; events fire per batch / window / dispatch, not
+  per example.
+
+Event model (Chrome trace "X" complete events plus flow events):
+
+- every span carries ``pid``/``tid`` so each execution context — the
+  reader thread, every parse worker (thread or spawned process), the
+  transfer thread, the train loop — renders as its own lane;
+- correlation ids ride ``args``: ``seq`` (reader work-item sequence
+  number) joins ``read.item`` → ``ring.slot_acquire`` → ``parse.batch``;
+  the pipeline's delivery point bridges ``seq`` → ``batch`` (delivered
+  batch index), and the prefetcher groups batches into ``sb``
+  (super-batch id) which the train loop's ``train.dispatch`` span
+  closes — one super-batch's life is a connected chain from file read
+  to fused-scan dispatch (tools/report.py --trace walks it);
+- flow arrows (``ph: s/t/f`` with id ``sb<N>``) visually link each
+  super-batch's stack → H2D → dispatch across lanes.
+
+Timestamps are ``time.perf_counter`` microseconds (CLOCK_MONOTONIC on
+Linux — one clock shared by every process on the host, so worker spans
+merge without alignment).  Each dump records a wall-clock anchor so
+``tools/report.py --trace`` can also merge traces from DIFFERENT hosts
+(multi-rank fleets) onto one timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["Tracer", "NULL_TRACER", "SpanHandle"]
+
+# Backstop against unbounded growth on very long runs: ~1M events is
+# ~250 MB of JSON — far beyond what Perfetto loads comfortably anyway.
+# Past the cap new events are dropped and counted (reported in dump()).
+_MAX_EVENTS = 1_000_000
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+def _us(t: float) -> int:
+    return int(t * 1e6)
+
+
+class SpanHandle:
+    """Context manager recording one complete ("X") event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_flow", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args, flow):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+        self._flow = flow
+
+    def __enter__(self) -> "SpanHandle":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = time.perf_counter()
+        self._tracer.emit(
+            self._name, self._t0, t1 - self._t0,
+            args=self._args, flow=self._flow,
+        )
+
+
+class Tracer:
+    """Thread-safe in-memory Chrome-trace event collector.
+
+    ``span(name, args=..., flow=(phase, id))`` times a block;
+    ``point(name, args=...)`` marks an instant (rendered as a 1 µs
+    slice so report tooling treats every event uniformly);
+    ``emit(...)`` records a span from explicit timestamps (used to
+    re-emit worker-shipped spans under the worker's pid);
+    ``take()`` drains the buffered raw events (what a parse worker
+    ships back); ``add_raw`` ingests such a shipment;
+    ``dump(path)`` writes the Perfetto-loadable JSON.
+    """
+
+    def __init__(self, enabled: bool = True,
+                 process_name: Optional[str] = None,
+                 max_events: int = _MAX_EVENTS):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._dropped = 0
+        self._max = max_events
+        self._pid = os.getpid()
+        self._named_tids: set = set()
+        self._process_name = process_name
+        self._wall_anchor = time.time()
+        self._perf_anchor = time.perf_counter()
+        if enabled and process_name:
+            self.name_process(process_name)
+
+    # ------------------------------------------------------------------
+    # metadata
+    # ------------------------------------------------------------------
+
+    def name_process(self, name: str) -> None:
+        if not self.enabled:
+            return
+        self._append({
+            "ph": "M", "name": "process_name", "pid": self._pid, "tid": 0,
+            "args": {"name": name},
+        })
+
+    def name_thread(self, name: str) -> None:
+        """Label the CURRENT thread's lane (idempotent per thread)."""
+        if not self.enabled:
+            return
+        tid = threading.get_ident()
+        with self._lock:
+            if tid in self._named_tids:
+                return
+            self._named_tids.add(tid)
+        self._append({
+            "ph": "M", "name": "thread_name", "pid": self._pid, "tid": tid,
+            "args": {"name": name},
+        })
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+
+    def span(self, name: str, args: Optional[dict] = None, flow=None):
+        """``with tracer.span("stage", args={"sb": 3}): ...``
+
+        ``flow`` is an optional ``(phase, id)`` pair with phase in
+        ``{"s", "t", "f"}`` (flow start / step / end) — the arrow that
+        visually links this span to the others sharing the id.
+        """
+        if not self.enabled:
+            return _NULL_CTX
+        return SpanHandle(self, name, args, flow)
+
+    def emit(self, name: str, t0: float, dur_s: float,
+             args: Optional[dict] = None, pid: Optional[int] = None,
+             tid: Optional[int] = None, flow=None) -> None:
+        """Record one complete event from explicit perf_counter times."""
+        if not self.enabled:
+            return
+        pid = self._pid if pid is None else pid
+        tid = threading.get_ident() if tid is None else tid
+        ts = _us(t0)
+        ev = {
+            "ph": "X", "name": name, "cat": "tffm", "ts": ts,
+            "dur": max(1, _us(dur_s)), "pid": pid, "tid": tid,
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+        if flow is not None:
+            phase, fid = flow
+            fev = {
+                "ph": phase, "name": "sb", "cat": "tffm_flow",
+                "id": str(fid), "ts": ts, "pid": pid, "tid": tid,
+            }
+            if phase == "f":
+                fev["bp"] = "e"  # bind the flow end to the enclosing slice
+            self._append(fev)
+
+    def point(self, name: str, args: Optional[dict] = None) -> None:
+        """Mark an instant (1 µs slice, so report tooling sees one event
+        shape everywhere)."""
+        if not self.enabled:
+            return
+        self.emit(name, time.perf_counter(), 0.0, args=args)
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self._max:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    # ------------------------------------------------------------------
+    # cross-process shipping
+    # ------------------------------------------------------------------
+
+    def take(self) -> list:
+        """Drain and return the buffered raw events (worker side: ship
+        these with the next result message)."""
+        if not self.enabled:
+            return []
+        with self._lock:
+            evs, self._events = self._events, []
+        return evs
+
+    def add_raw(self, events) -> None:
+        """Ingest events shipped from another Tracer (they already carry
+        their own pid/tid; perf_counter is host-wide, so no shifting)."""
+        if not self.enabled or not events:
+            return
+        with self._lock:
+            room = self._max - len(self._events)
+            if room <= 0:
+                self._dropped += len(events)
+                return
+            self._events.extend(events[:room])
+            self._dropped += max(0, len(events) - room)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def reset(self) -> None:
+        """Drop buffered events and re-anchor (per-run accounting, like
+        Telemetry.reset).  The process-name metadata survives — it names
+        the lane, not the run."""
+        with self._lock:
+            self._events = []
+            self._dropped = 0
+            self._named_tids = set()
+        self._wall_anchor = time.time()
+        self._perf_anchor = time.perf_counter()
+        if self.enabled and self._process_name:
+            self.name_process(self._process_name)
+
+    def dump(self, path: str) -> int:
+        """Write the Perfetto-loadable JSON; returns the event count.
+
+        ``otherData`` carries the wall/perf clock anchors so
+        ``tools/report.py --trace`` can place traces from different
+        hosts (multi-rank runs) on one wall-clock timeline.
+        """
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "wall_anchor": self._wall_anchor,
+                "perf_anchor": self._perf_anchor,
+                "pid": self._pid,
+                "dropped_events": dropped,
+            },
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return len(events)
+
+
+NULL_TRACER = Tracer(enabled=False)

@@ -27,7 +27,7 @@ import json
 import logging
 import time
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +72,48 @@ class TrainState(NamedTuple):
     step: jax.Array
 
 
+class NonFiniteGradError(RuntimeError):
+    """Raised by ``nan_policy = halt`` when a dispatch produced a
+    non-finite (NaN/inf) gradient.  Training stops WITHOUT overwriting
+    the checkpoint with poisoned params (periodic saves from before the
+    event survive); the metrics stream's final record carries the
+    exception type and the health counters."""
+
+
+class HealthState(NamedTuple):
+    """On-device training-health monitors riding the scan carry.
+
+    Updated once per fused-scan step from gradients the step already
+    materialized, so the marginal cost is a handful of reductions plus
+    one [B*F] -> [vocab] boolean scatter — noise next to the step.  The
+    host reads these OUTSIDE the hot path: a one-dispatch-delayed async
+    copy of the scalars drives ``nan_policy``, and the occupancy sums
+    are computed at logging cadence (never from the heartbeat thread,
+    which must stay host-only).
+    """
+
+    grad_sq_last: jax.Array  # squared global grad norm, last step
+    grad_sq_sum: jax.Array  # running sum over all steps (RMS reporting)
+    nonfinite_steps: jax.Array  # int32: steps with any non-finite grad
+    first_nonfinite_step: jax.Array  # int32: step index, -1 = never
+    # f32 instead of int: totals overflow int32 at scale, and jax's
+    # default x64-disabled mode would silently truncate int64.  Exact to
+    # 2^24 events per step-increment, which is plenty for a monitor.
+    touch_events: jax.Array  # f32: cumulative real feature occurrences
+    rows_touched: jax.Array  # bool[vocab]: rows ever touched this run
+
+    @staticmethod
+    def zeros(vocab: int) -> "HealthState":
+        return HealthState(
+            grad_sq_last=jnp.zeros((), jnp.float32),
+            grad_sq_sum=jnp.zeros((), jnp.float32),
+            nonfinite_steps=jnp.zeros((), jnp.int32),
+            first_nonfinite_step=jnp.full((), -1, jnp.int32),
+            touch_events=jnp.zeros((), jnp.float32),
+            rows_touched=jnp.zeros((vocab,), jnp.bool_),
+        )
+
+
 def _metric_update(
     ms: MetricState, scores, labels, weights, loss_type: str
 ) -> MetricState:
@@ -84,10 +126,26 @@ def _metric_update(
     )
 
 
-def make_train_step(cfg: FmConfig, optimizer):
-    """Dense train step (optax): full-table optimizer update each step."""
+def _tree_grad_health(grads):
+    """(grad_sq, nonfinite_count) over a dense gradient pytree."""
+    leaves = jax.tree.leaves(grads)
+    grad_sq = sum(
+        jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves
+    )
+    nonfinite = sum(
+        jnp.sum((~jnp.isfinite(g)).astype(jnp.int32)) for g in leaves
+    )
+    return grad_sq, nonfinite
 
-    def step(state: TrainState, batch: Batch) -> TrainState:
+
+def make_train_step(cfg: FmConfig, optimizer, with_health: bool = False):
+    """Dense train step (optax): full-table optimizer update each step.
+
+    ``with_health=True`` returns ``(state, (grad_sq, nonfinite))`` —
+    the health aux the scan carry accumulates (the dense path reduces
+    the full gradient pytree it already materialized)."""
+
+    def step(state: TrainState, batch: Batch):
         def loss_fn(params):
             return fm.loss_and_metrics(
                 params,
@@ -109,12 +167,16 @@ def make_train_step(cfg: FmConfig, optimizer):
             state.metrics, aux["scores"], batch.labels, batch.weights,
             cfg.loss_type,
         )
-        return TrainState(params, opt_state, ms, state.step + 1)
+        new_state = TrainState(params, opt_state, ms, state.step + 1)
+        if with_health:
+            return new_state, _tree_grad_health(grads)
+        return new_state
 
     return step
 
 
-def make_sparse_train_step(cfg: FmConfig, mesh=None):
+def make_sparse_train_step(cfg: FmConfig, mesh=None,
+                           with_health: bool = False):
     """Sparse train step: optimizer touches only the batch's rows
     (train.sparse — the IndexedSlices path, SURVEY.md §3.2).  The mesh is
     threaded through so the Pallas kernel runs under shard_map (Mosaic
@@ -137,25 +199,67 @@ def make_sparse_train_step(cfg: FmConfig, mesh=None):
             f"model_shards*{sparse_lib.sparse_apply.TILE}"
         )
 
-    def step(state: TrainState, batch: Batch) -> TrainState:
+    def step(state: TrainState, batch: Batch):
         if use_shardmap:
-            params, opt_state, scores = shardmap_step.sparse_step_shardmap(
-                cfg, state.params, state.opt_state, batch, mesh
+            out = shardmap_step.sparse_step_shardmap(
+                cfg, state.params, state.opt_state, batch, mesh,
+                health=with_health,
             )
         else:
-            params, opt_state, scores = sparse_lib.sparse_step(
+            out = sparse_lib.sparse_step(
                 cfg, state.params, state.opt_state, batch,
                 mesh=mesh, data_axis=mesh_lib.DATA_AXIS,
+                health=with_health,
             )
+        params, opt_state, scores = out[0], out[1], out[2]
         ms = _metric_update(
             state.metrics, scores, batch.labels, batch.weights, cfg.loss_type
         )
-        return TrainState(params, opt_state, ms, state.step + 1)
+        new_state = TrainState(params, opt_state, ms, state.step + 1)
+        if with_health:
+            return new_state, out[3]
+        return new_state
 
     return step
 
 
-def make_scan_train_step(step_fn):
+def make_health_update(cfg: FmConfig):
+    """(health, new_state, batch, aux) -> health, applied once per scan
+    step: fold the step's grad aux into the carry and mark the batch's
+    real (val != 0) ids in the row-touch mask.  Padded occurrences map
+    to index ``vocab`` and drop out of the scatter."""
+    vocab = cfg.vocabulary_size
+
+    def update(health: HealthState, new_state: TrainState, batch: Batch,
+               aux) -> HealthState:
+        grad_sq, nonfinite = aux
+        bad = nonfinite > 0
+        real = batch.vals.reshape(-1) != 0
+        ids = jnp.where(real, batch.ids.reshape(-1), vocab)
+        this_step = new_state.step - 1  # the step this batch trained
+        return HealthState(
+            grad_sq_last=grad_sq,
+            grad_sq_sum=health.grad_sq_sum + grad_sq,
+            nonfinite_steps=(
+                health.nonfinite_steps + bad.astype(jnp.int32)
+            ),
+            first_nonfinite_step=jnp.where(
+                bad & (health.first_nonfinite_step < 0),
+                this_step.astype(jnp.int32),
+                health.first_nonfinite_step,
+            ),
+            touch_events=(
+                health.touch_events + jnp.sum(real, dtype=jnp.float32)
+            ),
+            rows_touched=health.rows_touched.at[ids].set(
+                True, mode="drop"
+            ),
+        )
+
+    return update
+
+
+def make_scan_train_step(step_fn, health_update=None):
     """Wrap a (state, batch) -> state train step in ``jax.lax.scan`` over
     a stacked super-batch: ONE dispatch trains K steps with zero
     intervening Python/host round-trips (the device-resident hot loop the
@@ -167,16 +271,38 @@ def make_scan_train_step(step_fn):
     on-device sort.  K is baked into the trace: the jitted wrapper
     retraces per distinct K, so an epoch tail at K' = leftover costs one
     extra compile the first time that K' appears.
+
+    With ``health_update``, ``step_fn`` must return ``(state, aux)`` and
+    the wrapper becomes ``(state, health, batches) -> (state, health)``:
+    a :class:`HealthState` rides the scan carry alongside the TrainState
+    — grad-norm / non-finite / row-touch monitors updated on-device
+    every step, read back by the host only at dispatch boundaries.  The
+    health carry is deliberately NOT donated (it is a separate argument)
+    so the host can keep the previous dispatch's scalars alive for its
+    delayed ``nan_policy`` check without racing buffer donation.
     """
+    if health_update is None:
 
-    def scan_step(state: TrainState, batches: Batch) -> TrainState:
+        def scan_step(state: TrainState, batches: Batch) -> TrainState:
+            def body(carry, batch):
+                return step_fn(carry, batch), None
+
+            state, _ = jax.lax.scan(body, state, batches)
+            return state
+
+        return scan_step
+
+    def scan_health_step(state: TrainState, health: HealthState,
+                         batches: Batch):
         def body(carry, batch):
-            return step_fn(carry, batch), None
+            s, h = carry
+            s2, aux = step_fn(s, batch)
+            return (s2, health_update(h, s2, batch, aux)), None
 
-        state, _ = jax.lax.scan(body, state, batches)
-        return state
+        (state, health), _ = jax.lax.scan(body, (state, health), batches)
+        return state, health
 
-    return scan_step
+    return scan_health_step
 
 
 def make_eval_step(cfg: FmConfig):
@@ -249,6 +375,13 @@ class Trainer:
         # transfer thread, and the dispatch loop.  Disabled -> every
         # instrument is a shared no-op (zero behavior change).
         self.telemetry = obs.Telemetry(enabled=cfg.telemetry)
+        # Causal batch tracer (Chrome-trace spans; obs/trace.py).  Only
+        # live when cfg.trace_file names an output — otherwise every
+        # span call is a shared no-op, and training is bit-identical.
+        self.tracer = obs.Tracer(
+            enabled=bool(cfg.trace_file),
+            process_name=f"trainer rank{jax.process_index()}",
+        )
         # Input-pipeline position for checkpointed mid-epoch resume.
         self._epoch = 0
         self._batches_done = 0
@@ -308,16 +441,35 @@ class Trainer:
             out_shardings=state_sh,
             donate_argnums=0,
         )
-        # K-step fused dispatch: the same step_fn under lax.scan over a
-        # stacked [K, ...] super-batch.  train() always dispatches through
-        # this (steps_per_dispatch == 1 is a scan of length 1, numerically
+        # K-step fused dispatch: the same step math under lax.scan over
+        # a stacked [K, ...] super-batch, with the HealthState monitors
+        # riding the carry (grad-norm, non-finite detection, row-touch
+        # mask — updated on-device per step, read back at dispatch
+        # boundaries).  train() always dispatches through this
+        # (steps_per_dispatch == 1 is a scan of length 1, numerically
         # identical to the single step); _train_step stays for direct
-        # single-batch callers (bench step-only mode, tests).
+        # single-batch callers (bench step-only mode, tests) and carries
+        # no health.
         self._super_batch_sh = Batch(**mesh_lib.super_batch_sharding(self.mesh))
-        self._scan_train_step = jax.jit(
-            make_scan_train_step(step_fn),
-            in_shardings=(state_sh, self._super_batch_sh),
-            out_shardings=state_sh,
+        step_fn_health = (
+            make_sparse_train_step(cfg, self.mesh, with_health=True)
+            if self.sparse
+            else make_train_step(cfg, self.optimizer, with_health=True)
+        )
+        self._health = jax.device_put(
+            HealthState.zeros(cfg.vocabulary_size), rep
+        )
+        self._health_host: dict = {}  # last host-read health scalars
+        self._health_step0 = int(self.state.step)  # run-start step base
+        health_sh = jax.tree.map(lambda x: x.sharding, self._health)
+        # Only the TrainState is donated: the un-donated health arrays
+        # let the host keep the PREVIOUS dispatch's nonfinite/grad-norm
+        # scalars alive for the delayed nan_policy check (a donated
+        # carry would invalidate them under the next dispatch).
+        self._scan_health_jit = jax.jit(
+            make_scan_train_step(step_fn_health, make_health_update(cfg)),
+            in_shardings=(state_sh, health_sh, self._super_batch_sh),
+            out_shardings=(state_sh, health_sh),
             donate_argnums=0,
         )
         ms_sh = jax.tree.map(lambda _: rep, MetricState.zeros())
@@ -407,6 +559,71 @@ class Trainer:
             "stays sweep-independent.", dev,
         )
         return expect
+
+    def _scan_train_step(self, state: TrainState, batches: Batch):
+        """One fused K-step dispatch (the hot-loop entry point).
+
+        Keeps the historical ``(state, batches) -> state`` surface —
+        bench step timing and the resume tests wrap exactly this — while
+        threading the health carry through ``self._health`` (monitors
+        never change the TrainState math, so scan parity with K single
+        ``_train_step`` calls stays bitwise)."""
+        state, self._health = self._scan_health_jit(
+            state, self._health, batches
+        )
+        return state
+
+    def _reset_health(self) -> None:
+        """Fresh per-run health carry (mirrors telemetry.reset).
+
+        ``state.step`` is instance-cumulative (a second train() on a
+        warm Trainer keeps counting), so the run's starting step is
+        pinned here: health reporting divides by PER-RUN steps and
+        rebases ``first_nonfinite_step`` to match the per-run ``step``
+        every other record carries."""
+        rep = NamedSharding(self.mesh, P())
+        self._health = jax.device_put(
+            HealthState.zeros(self.cfg.vocabulary_size), rep
+        )
+        self._health_step0 = int(self.state.step)
+
+    def _health_summary(self, exact: bool = False) -> dict:
+        """Host-side view of the health carry for records/results.
+
+        ``exact=False`` (heartbeat path) reports only the cached scalars
+        the dispatch loop already read back — never a device readback
+        from the heartbeat thread.  ``exact=True`` (log cadence / final)
+        syncs the scalars and computes the row-occupancy sums on device.
+        """
+        out = dict(self._health_host)
+        if exact:
+            try:
+                h = self._health
+                step0 = getattr(self, "_health_step0", 0)
+                steps = max(1, int(self.state.step) - step0)
+                rows = int(jnp.sum(h.rows_touched))
+                vocab = self.cfg.vocabulary_size
+                first_nf = int(h.first_nonfinite_step)
+                out.update({
+                    "grad_norm": round(
+                        float(jnp.sqrt(h.grad_sq_last)), 6
+                    ),
+                    "grad_norm_rms": round(
+                        float(jnp.sqrt(h.grad_sq_sum / steps)), 6
+                    ),
+                    "nonfinite_steps": int(h.nonfinite_steps),
+                    # Rebased to the per-run step every record carries.
+                    "first_nonfinite_step": (
+                        first_nf - step0 if first_nf >= 0 else -1
+                    ),
+                    "emb_rows_touched": rows,
+                    "emb_row_occupancy": round(rows / vocab, 6),
+                    "emb_touch_events": float(h.touch_events),
+                })
+                self._health_host = dict(out)
+            except Exception:  # pragma: no cover - wedged device
+                pass  # crash path: serve whatever was cached
+        return out
 
     def _put(self, batch: Batch, want_meta: bool = True) -> Batch:
         spec = self._sort_meta_spec() if want_meta else None
@@ -579,6 +796,8 @@ class Trainer:
                 "optimizer": cfg.optimizer,
                 "telemetry": cfg.telemetry,
                 "heartbeat_secs": cfg.heartbeat_secs,
+                "trace_file": cfg.trace_file,
+                "nan_policy": cfg.nan_policy,
                 "jax_version": jax.__version__,
                 "backend": jax.default_backend(),
                 "mesh": {str(a): int(n) for a, n in self.mesh.shape.items()},
@@ -600,6 +819,43 @@ class Trainer:
         # Reset IN PLACE so external references to trainer.telemetry
         # stay live.
         self.telemetry.reset()
+        self.tracer.reset()
+        # Fresh health carry + host cache per run; the nan_policy check
+        # below reads the PREVIOUS dispatch's scalars (async-copied right
+        # after each dispatch) so detection costs no pipeline bubble:
+        # by the time dispatch n+1 is enqueued, n has long finished on
+        # device and its scalars are already on the host.
+        self._reset_health()
+        self._health_host = {}
+        pending_health = None  # (nonfinite_arr, grad_sq_arr, stepno)
+        nonfinite_warned = False
+
+        def check_health(pending) -> None:
+            """Consume one delayed health readback; apply nan_policy."""
+            nonlocal nonfinite_warned
+            nf_arr, gs_arr, at_step = pending
+            nf = int(nf_arr)
+            gs = float(gs_arr)
+            self._health_host["grad_norm"] = round(
+                float(np.sqrt(gs)) if np.isfinite(gs) else gs, 6
+            )
+            self._health_host["nonfinite_steps"] = nf
+            if nf <= 0:
+                return
+            if not nonfinite_warned:
+                nonfinite_warned = True
+                log.warning(
+                    "non-finite (NaN/inf) gradient detected by step %d "
+                    "(%d bad step(s) so far; nan_policy=%s)",
+                    at_step, nf, cfg.nan_policy,
+                )
+            if cfg.nan_policy == "halt":
+                raise NonFiniteGradError(
+                    f"non-finite gradient within the first {at_step} "
+                    f"step(s) ({nf} bad step(s)); halting per "
+                    "nan_policy=halt — the checkpoint was NOT "
+                    "overwritten with poisoned params"
+                )
         # Starvation-vs-dispatch split: wait_input times next() on the
         # prefetcher (the loop is input-starved), dispatch times the
         # fused-scan call (includes any device backpressure block); wall
@@ -651,6 +907,7 @@ class Trainer:
             prestack_k=(k if cfg.cache_prestacked else 0),
             epoch_marks=True,
             telemetry=self.telemetry,
+            tracer=self.tracer,
         )
         # Transfer stage: a background thread stacks K parsed batches
         # and ships super-batch n+1 (shard + device_put) while n trains;
@@ -667,13 +924,28 @@ class Trainer:
             # pre-allocated staging buffers instead of allocating a
             # super-batch of host memory per dispatch.
             staging=True,
+            tracer=self.tracer,
         )
         cache_logged = not cfg.cache_epochs
 
-        def telemetry_record(kind: str) -> dict:
+        def telemetry_record(kind: str):
             """One structured self-report (heartbeat/final), host-side
             only: counters/gauges/timers — never a device readback, which
-            would force a sync from the heartbeat thread mid-dispatch."""
+            would force a sync from the heartbeat thread mid-dispatch.
+
+            Heartbeats return None (skip the beat) until the FIRST
+            dispatch completes: before that, the wait timer has been
+            running since before any dispatch could exist (jit compile,
+            a resume's cached-epoch rebuild parse), and a wait-only
+            window would report ingest_wait_frac ≈ 1 — an over-count
+            that used to finger ingest for what is really startup.
+            The guard reads ``stepno`` (not the dispatch timer's count,
+            which is a permanent 0 with telemetry disabled — that would
+            silence every liveness beat of a --no_telemetry run).  The
+            final record always emits.
+            """
+            if kind == "heartbeat" and stepno == 0:
+                return None
             now = time.time()
             wall = max(now - t0, 1e-9)
             wait_s, disp_s = t_wait.total_s, t_disp.total_s
@@ -693,6 +965,10 @@ class Trainer:
                 "truncated_features": int(pipeline.truncated_features),
                 "out_of_range_batches": int(pipeline.oor_batches),
                 "ingest_cache": pipeline.cache_result,
+                # Training-health monitors (scan-carry): host-cached
+                # scalars only on the heartbeat path; exact values are
+                # refreshed at log cadence and for the final record.
+                "health": self._health_summary(exact=(kind == "final")),
                 "stages": self.telemetry.snapshot(),
             }
 
@@ -702,13 +978,24 @@ class Trainer:
                 cfg.heartbeat_secs, partial(telemetry_record, "heartbeat"),
                 writer=metrics_out,
             )
+        run_exc: Optional[BaseException] = None
+        total_trunc = 0
         try:
             try:
+                self.tracer.name_thread("train-loop")
                 source = iter(prefetcher)
+                # Dispatch counter = super-batch id: the prefetcher
+                # assigns sb in emission order and its bounded FIFO
+                # output queue preserves it, so this counter names the
+                # same super-batch its stack/h2d spans did — the trace
+                # chain's final link.
+                dispatch_idx = 0
                 while True:
                     # Starvation accounting: time blocked waiting for the
                     # next staged super-batch.
-                    with t_wait.time():
+                    with t_wait.time(), self.tracer.span(
+                        "train.wait_input"
+                    ):
                         item = next(source, None)
                     if item is None:
                         break
@@ -737,12 +1024,36 @@ class Trainer:
                     # The dispatch is async: this wall time is enqueue
                     # cost plus any device backpressure block — the
                     # compute-bound half of the wall-clock split.
-                    with t_disp.time(), obs.trace_span("tffm:dispatch"):
+                    with t_disp.time(), obs.trace_span("tffm:dispatch"), \
+                            self.tracer.span(
+                                "train.dispatch",
+                                args={"sb": dispatch_idx, "k": kk,
+                                      "step0": stepno},
+                                flow=("f", f"sb{dispatch_idx}"),
+                            ):
                         self.state = self._scan_train_step(
                             self.state, super_batch
                         )
+                    dispatch_idx += 1
                     stepno += kk
                     self._batches_done += kk
+                    # Health readback, one dispatch delayed: start an
+                    # async D2H copy of THIS dispatch's scalars, then
+                    # consume the PREVIOUS dispatch's (already resident —
+                    # that dispatch finished on device while this one's
+                    # input staged, so the read never stalls the
+                    # pipeline).  nan_policy=halt therefore fires within
+                    # one dispatch of the poisoned one.
+                    nf_arr = self._health.nonfinite_steps
+                    gs_arr = self._health.grad_sq_last
+                    try:
+                        nf_arr.copy_to_host_async()
+                        gs_arr.copy_to_host_async()
+                    except Exception:  # pragma: no cover - backend drift
+                        pass
+                    if pending_health is not None:
+                        check_health(pending_health)
+                    pending_health = (nf_arr, gs_arr, stepno)
                     if profiling and stepno >= profile_stop_at:
                         jax.block_until_ready(self.state)
                         jax.profiler.stop_trace()
@@ -767,6 +1078,11 @@ class Trainer:
                             now - last_log_t, 1e-9
                         )
                         last_log_t, last_log_ex = now, m["examples"]
+                        # The log readback already synced the host;
+                        # piggyback the exact health refresh (row
+                        # occupancy included) so heartbeats between
+                        # logs serve fresh cached values.
+                        self._health_summary(exact=True)
                         log.info(
                             "step %d examples %d loss %.6f auc %.4f "
                             "ex/s %.0f",
@@ -827,8 +1143,24 @@ class Trainer:
                         cfg.save_steps
                         and stepno - last_save_step >= cfg.save_steps
                     ):
+                        # Consume THIS dispatch's health scalars before
+                        # writing the checkpoint: the delayed check
+                        # alone would let a save in the same iteration
+                        # persist NaN-poisoned params, breaking halt's
+                        # "checkpoint not overwritten" guarantee.  The
+                        # blocking read costs one device sync at save
+                        # cadence only.
+                        if pending_health is not None:
+                            check_health(pending_health)
+                            pending_health = None
                         last_save_step = stepno
                         self.save(stepno)
+                # Stream exhausted: consume the last delayed health
+                # readback so a NaN in the final dispatch still trips
+                # nan_policy before the end-of-run save.
+                if pending_health is not None:
+                    check_health(pending_health)
+                    pending_health = None
             finally:
                 if heartbeat is not None:
                     heartbeat.close()
@@ -841,18 +1173,44 @@ class Trainer:
                     "%d feature occurrences dropped by max_features=%d "
                     "over the run", total_trunc, cfg.max_features,
                 )
-            # The stream's last word: one exact end-of-run self-report
-            # (the heartbeat's schema with record="final"), written even
-            # when periodic heartbeats are off.
-            self._final_record = telemetry_record("final")
-            if metrics_out is not None:
-                metrics_out.write(self._final_record)
+        except BaseException as e:
+            run_exc = e
+            raise
         finally:
             # An abandoned trace poisons any later start_trace in-process.
             if profiling:
                 jax.profiler.stop_trace()
+            # Crash-truthful stream: the final record is written from
+            # this finally, so a run that died mid-flight (preemption,
+            # worker crash, nan_policy=halt) still closes its JSONL with
+            # exception type + partial counters — tools/report.py can
+            # summarize exactly what happened instead of trailing off at
+            # the last heartbeat.
+            self._final_record = telemetry_record("final")
+            if run_exc is not None:
+                self._final_record["exception"] = type(run_exc).__name__
+                self._final_record["exception_msg"] = str(run_exc)[:300]
             if metrics_out is not None:
+                try:
+                    metrics_out.write(self._final_record)
+                except Exception as e:
+                    # A full metrics volume must not mask the run's own
+                    # outcome (this block runs on the crash path too).
+                    log.warning("final record write failed: %s", e)
                 metrics_out.close()
+            if self.tracer.enabled:
+                # One trace file per process: rank 0 writes the
+                # configured path, ranks > 0 suffix theirs (the
+                # documented naming — config.py/cli.py), and
+                # tools/report.py --trace merges the fleet.
+                tpath = cfg.trace_file
+                if jax.process_index() > 0:
+                    tpath = f"{tpath}.rank{jax.process_index()}"
+                try:
+                    n_ev = self.tracer.dump(tpath)
+                    log.info("wrote %d trace events to %s", n_ev, tpath)
+                except OSError as e:  # pragma: no cover - full volume
+                    log.warning("trace dump failed: %s", e)
         train_metrics = _finalize_metrics(self.state.metrics, cfg.loss_type)
         train_metrics["examples_per_sec"] = (
             train_metrics["examples"] / max(time.time() - t0, 1e-9)
@@ -871,6 +1229,13 @@ class Trainer:
         )
         train_metrics["wait_input_s"] = self._final_record["wait_input_s"]
         train_metrics["dispatch_s"] = self._final_record["dispatch_s"]
+        # Training-health summary (exact end-of-run values from the scan
+        # carry): grad norms, non-finite counts, embedding-row touch /
+        # occupancy — the model-health companions to the data-integrity
+        # counters above.
+        train_metrics["health"] = dict(
+            self._final_record.get("health", {})
+        )
         self.save(stepno)
         result = {"train": train_metrics}
         if cfg.validation_files:

@@ -122,8 +122,12 @@ def _rebuild_opt(cfg: FmConfig, opt_state, new_tables, dw0, w0_old):
 
 
 def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
-                         mesh):
-    """One sparse train step, hand-sharded. Returns (params, opt, scores)."""
+                         mesh, health: bool = False):
+    """One sparse train step, hand-sharded. Returns (params, opt, scores),
+    plus a ``(grad_sq, nonfinite_count)`` health aux when ``health=True``
+    — each quantity reduced locally from the shard's own (masked)
+    occurrence grads and psum'd over BOTH mesh axes, so the monitor is
+    global at the cost of two extra scalar collectives per step."""
     model_shards = mesh.shape[MODEL_AXIS]
     vocab_local = cfg.vocabulary_size // model_shards
     k = cfg.factor_num
@@ -281,11 +285,27 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
             # must land here too or w0 diverges from the scatter path.
             bsz_g = jax.lax.psum(jnp.float32(vals.shape[0]), DATA_AXIS)
             dw0 = dw0 + 2.0 * cfg.bias_lambda * w0 / bsz_g
-        return (w_new, scores, dw0) + tuple(new_tables)
+        outs = (w_new, scores, dw0) + tuple(new_tables)
+        if health:
+            # Each occurrence's grad lives on exactly ONE model shard
+            # (off-shard rows are masked to zero), so summing local
+            # squares over both axes is the global occurrence-grad norm
+            # — no double counting.  dw0 is already global; folded in
+            # by the caller.
+            gsq = jax.lax.psum(
+                jnp.sum(jnp.square(g_flat)), (MODEL_AXIS, DATA_AXIS)
+            )
+            nonfin = jax.lax.psum(
+                jnp.sum((~jnp.isfinite(g_flat)).astype(jnp.int32)),
+                (MODEL_AXIS, DATA_AXIS),
+            )
+            outs = outs + (gsq, nonfin)
+        return outs
 
     out_specs = (
         (P(MODEL_AXIS, None), P(DATA_AXIS), P())
         + (P(MODEL_AXIS, None),) * n_opt
+        + ((P(), P()) if health else ())
     )
     from fast_tffm_tpu.platform import shard_map
 
@@ -304,11 +324,17 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
         batch.fields, batch.weights, *_opt_tables(cfg, opt_state),
     )
     table_new, scores, dw0 = outs[0], outs[1], outs[2]
-    new_opt_tables = outs[3:]
+    new_opt_tables = outs[3:-2] if health else outs[3:]
     w0_new, opt_new = _rebuild_opt(
         cfg, opt_state, new_opt_tables, dw0, params.w0
     )
-    return fm.FmParams(w0=w0_new, table=table_new), opt_new, scores
+    new_params = fm.FmParams(w0=w0_new, table=table_new)
+    if health:
+        gsq, nonfin = outs[-2], outs[-1]
+        grad_sq = gsq + jnp.square(dw0)
+        nonfin = nonfin + (~jnp.isfinite(dw0)).astype(jnp.int32)
+        return new_params, opt_new, scores, (grad_sq, nonfin)
+    return new_params, opt_new, scores
 
 
 def _apply_stream(cfg, tile_start, u, w_l, opt_tables_l):

@@ -294,11 +294,34 @@ def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows,
 _APPLY = {"adagrad": _apply_adagrad, "ftrl": _apply_ftrl, "sgd": _apply_sgd}
 
 
+def grad_health(g_rows, dw0):
+    """(grad_sq, nonfinite_count) for a step's gradients — the on-device
+    training-health aux the scan carry accumulates (train.loop).
+
+    ``grad_sq`` is the squared global gradient norm at OCCURRENCE
+    granularity: duplicate ids in a batch contribute per occurrence
+    (matching the per-occurrence accumulator semantics of the sparse
+    optimizers), where a dense table-gradient norm would first sum
+    duplicates per row.  For a health monitor the distinction is noise;
+    for NaN detection it is irrelevant (any non-finite occurrence grad
+    poisons the row either way).
+    """
+    grad_sq = jnp.sum(jnp.square(g_rows)) + jnp.square(dw0)
+    nonfinite = (
+        jnp.sum((~jnp.isfinite(g_rows)).astype(jnp.int32))
+        + (~jnp.isfinite(dw0)).astype(jnp.int32)
+    )
+    return grad_sq, nonfinite
+
+
 def sparse_step(
     cfg: FmConfig, params: fm.FmParams, opt_state, batch: Batch,
-    mesh=None, data_axis: str = "data",
+    mesh=None, data_axis: str = "data", health: bool = False,
 ):
-    """One sparse train step. Returns (params, opt_state, scores)."""
+    """One sparse train step. Returns (params, opt_state, scores), plus
+    a ``(grad_sq, nonfinite_count)`` health aux when ``health=True``
+    (computed from the per-occurrence row grads this step already
+    materialized — no extra memory traffic)."""
     rows = params.table[batch.ids]  # [B, F, D]
     loss_fn = _rows_loss_fn(
         cfg, batch, mesh, data_axis, compute_dtype=cfg.compute_jnp_dtype
@@ -315,4 +338,6 @@ def sparse_step(
         mode=mode, mesh=mesh,
         meta=batch.sort_meta if mode == "tile" else None,
     )
+    if health:
+        return params, opt_state, scores, grad_health(g_rows, dw0)
     return params, opt_state, scores

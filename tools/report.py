@@ -1,35 +1,47 @@
 #!/usr/bin/env python
-"""Pretty-print / summarize a telemetry+metrics JSONL stream.
+"""Pretty-print / summarize fast_tffm_tpu observability artifacts.
 
-The trainer's ``metrics_file`` is self-describing (every record carries
-a ``record`` type: run_header | train | validation | heartbeat | final);
-this tool turns one file into a human summary:
+Three modes (see OBSERVABILITY.md):
 
-  python tools/report.py /path/to/metrics.jsonl
-  python tools/report.py rank0.jsonl rank1.jsonl ...   # multi-host merge
+1. Metrics stream summary (default).  The trainer's ``metrics_file`` is
+   self-describing (every record carries a ``record`` type: run_header |
+   train | validation | heartbeat | final):
 
-Sections: the run header (config fingerprint, dispatch/ingest mode,
-platform), the train/validation progression, and the end-of-run
-wall-clock attribution — starvation (``ingest_wait_frac``) vs dispatch
-vs other, per-stage timing histograms, per-put/get queue-depth
-histograms, and the data-integrity counters (truncated features,
-out-of-range-id batches, cache outcome).  Records from pre-telemetry
-runs (no ``record`` field) are classified by their keys, so old files
-still summarize.
+     python tools/report.py /path/to/metrics.jsonl
+     python tools/report.py rank0.jsonl rank1.jsonl ...  # fleet merge
 
-Multi-host runs write one metrics_file per process, each tagged with
-its ``rank`` (jax.process_index) in the run header; passing several
-files merges them into one fleet view — a per-rank attribution table
-plus the full breakdown of the SLOWEST rank (the step waits for every
-host, so the fleet bottleneck is whichever rank starves hardest).
+   Sections: the run header (config fingerprint, dispatch/ingest mode,
+   platform), the train/validation progression, and the end-of-run
+   wall-clock attribution — starvation (``ingest_wait_frac``) vs
+   dispatch vs other, per-stage timing histograms, per-put/get
+   queue-depth histograms, the data-integrity counters, and the
+   training-health monitors (grad norm, non-finite steps, embedding
+   occupancy).  Multi-host runs write one metrics_file per process,
+   tagged with ``rank``; passing several files prints a per-rank
+   attribution table plus the full breakdown of the SLOWEST rank.
 
-Dependency-free on purpose: it must run on any box the JSONL lands on,
-jax or not.
+2. ``--trace``: merge one or more Chrome-trace span files (written by
+   ``trace_file`` / ``--trace``; one per rank) into a single
+   Perfetto-loadable file (``-o``, default ``<first>.merged.json``) and
+   print a critical-path summary: per-stage span totals, and for every
+   dispatched super-batch the connected chain read → ring slot → parse
+   → deliver → stack → H2D → dispatch with the slowest chains broken
+   down segment by segment.
+
+3. ``--compare A B``: ratio-diff two runs — metrics JSONLs or bench
+   JSONs (BENCH_rN.json) — and flag regressions beyond ``--threshold``
+   (default 5%).  Rates/ratios regress when they FALL; times/fractions
+   /losses regress when they RISE.  Exit code 2 when any regression is
+   flagged, so the BENCH trajectory check stops being eyeball-only.
+
+Dependency-free on purpose: it must run on any box the artifacts land
+on, jax or not.
 """
 
 from __future__ import annotations
 
 import argparse
+import bisect
 import json
 import sys
 
@@ -113,6 +125,9 @@ def _print_breakdown(rec: dict) -> None:
     disp = rec.get("dispatch_s", 0.0)
     other = rec.get("other_s", max(0.0, wall - wait - disp))
     frac = rec.get("ingest_wait_frac", wait / wall)
+    if rec.get("exception"):
+        print(f"\n  !! run DIED with {rec['exception']}: "
+              f"{rec.get('exception_msg', '')}")
     print(f"\nwall-clock attribution ({kind} record, step "
           f"{rec.get('step', '?')}, {wall:.1f}s):")
     print(f"  waiting for input   {wait:>9.2f}s  ({100 * wait / wall:5.1f}%)"
@@ -130,6 +145,17 @@ def _print_breakdown(rec: dict) -> None:
                 "ingest_cache", "examples_in"):
         if key in rec:
             print(f"  {key:22s} {rec[key]}")
+    health = rec.get("health") or {}
+    if health:
+        print("\ntraining health (scan-carry monitors):")
+        for key in ("grad_norm", "grad_norm_rms", "nonfinite_steps",
+                    "first_nonfinite_step", "emb_rows_touched",
+                    "emb_row_occupancy", "emb_touch_events"):
+            if key in health:
+                print(f"  {key:22s} {health[key]}")
+        if health.get("nonfinite_steps", 0):
+            print("  !! non-finite gradients occurred — the model is "
+                  "numerically unhealthy (see nan_policy)")
     stages = rec.get("stages") or {}
     timers = stages.get("timers") or {}
     if timers:
@@ -222,16 +248,417 @@ def _merge_ranks(streams: list) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --trace: merge Chrome-trace span files + critical-path summary
+# ---------------------------------------------------------------------------
+
+
+def load_trace(path: str) -> tuple[list, dict]:
+    """(events, otherData) from one trace file (object or bare-array
+    Chrome trace format)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare traceEvents array
+        return doc, {}
+    return doc.get("traceEvents", []), doc.get("otherData", {})
+
+
+def merge_traces(paths: list) -> tuple[list, list, list]:
+    """Merge per-rank/per-process trace files onto ONE timeline.
+
+    Timestamps are perf_counter µs — already shared across processes of
+    one host.  Across hosts each file's ``otherData`` anchors give the
+    wall-clock offset; events are shifted onto the wall timeline and
+    re-zeroed at the earliest event.  Returns (events, notes,
+    per_file_events) — the per-file lists are the UNSHIFTED originals,
+    for chain reconstruction (which is per-rank and only needs
+    intra-file deltas), so a near-cap 250 MB trace is parsed once.
+    """
+    notes = []
+    all_events = []
+    per_file = []
+    for path in paths:
+        events, other = load_trace(path)
+        per_file.append(events)
+        shift = 0
+        if "wall_anchor" in other and "perf_anchor" in other:
+            shift = int(
+                (other["wall_anchor"] - other["perf_anchor"]) * 1e6
+            )
+        dropped = other.get("dropped_events", 0)
+        if dropped:
+            notes.append(f"{path}: {dropped} events were dropped at "
+                         "record time (buffer cap)")
+        for ev in events:
+            if "ts" in ev:
+                ev = dict(ev)
+                ev["ts"] += shift
+            all_events.append(ev)
+    tss = [ev["ts"] for ev in all_events if "ts" in ev]
+    if tss:
+        t0 = min(tss)
+        for ev in all_events:
+            if "ts" in ev:
+                ev["ts"] -= t0
+    return all_events, notes, per_file
+
+
+def trace_chains(events: list) -> list:
+    """Reconstruct each dispatched super-batch's span chain.
+
+    Join keys (see obs/trace.py): ``train.dispatch`` and the
+    prefetcher's ``prefetch.stack``/``prefetch.h2d`` spans share ``sb``;
+    the stack span names its batch range (``batch0``, ``n``);
+    ``ingest.deliver`` points bridge ``batch`` -> ``seq`` (one point may
+    cover ``n`` batches — a prestacked SuperBatch delivers whole);
+    ``seq`` joins ``parse.batch``, ``ring.slot_acquire``, and
+    ``read.item``.  Returns one dict per dispatch: {sb, dispatch, stack,
+    h2d, batches: [{batch, seq, deliver, parse, read}...], complete,
+    latency_us}.
+
+    Contract: ``events`` must come from ONE rank's trace (sb/seq/batch
+    ids restart per rank); ``trace_mode`` therefore builds chains per
+    input file before merging the timeline.
+    """
+    by_name: dict = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            by_name.setdefault(ev.get("name"), []).append(ev)
+
+    def args_index(name, key):
+        out = {}
+        for ev in by_name.get(name, []):
+            a = ev.get("args") or {}
+            if key in a:
+                out[a[key]] = ev
+        return out
+
+    dispatches = args_index("train.dispatch", "sb")
+    stacks = args_index("prefetch.stack", "sb")
+    h2ds = args_index("prefetch.h2d", "sb")
+    parses = args_index("parse.batch", "seq")
+    reads = args_index("read.item", "seq")
+    delivers = {}
+    for ev in by_name.get("ingest.deliver", []):
+        a = ev.get("args") or {}
+        if "batch" in a:
+            # One deliver point covers its whole batch range (n > 1 for
+            # prestacked SuperBatches delivered whole).
+            for i in range(a["batch"], a["batch"] + a.get("n", 1)):
+                delivers[i] = ev
+    # ring windows: sorted seq0 list; a batch seq belongs to the last
+    # window at or before it (bisect — a near-cap trace can hold 1e5
+    # windows x 1e6 batches, so per-batch linear scans would hang the
+    # tool on exactly the traces it exists for).  Sort on the seq key
+    # only (tuple comparison would fall through to the event dicts on
+    # ties).
+    rings = sorted(
+        (
+            (ev.get("args", {}).get("seq"), ev)
+            for ev in by_name.get("ring.slot_acquire", [])
+            if ev.get("args", {}).get("seq") is not None
+        ),
+        key=lambda pair: pair[0],
+    )
+    ring_seqs = [s0 for s0, _ in rings]
+
+    def ring_for(seq):
+        i = bisect.bisect_right(ring_seqs, seq)
+        return rings[i - 1][1] if i else None
+
+    chains = []
+    for sb, disp in sorted(dispatches.items()):
+        stack = stacks.get(sb)
+        h2d = h2ds.get(sb)
+        # Prestacked super-batches have no transfer-stage stack; their
+        # h2d span carries the batch range instead.
+        rng_ev = stack if stack is not None else h2d
+        batches = []
+        if rng_ev is not None:
+            a = rng_ev.get("args") or {}
+            b0, n = a.get("batch0"), a.get("n")
+            if b0 is not None and n is not None:
+                for b in range(b0, b0 + n):
+                    dv = delivers.get(b)
+                    seq = (dv.get("args") or {}).get("seq") if dv else None
+                    batches.append({
+                        "batch": b, "seq": seq, "deliver": dv,
+                        "parse": parses.get(seq) if seq is not None
+                        else None,
+                        "read": reads.get(seq) if seq is not None
+                        else None,
+                        "ring": ring_for(seq) if seq is not None
+                        else None,
+                    })
+        # A chain is complete when the dispatch connects through h2d to
+        # its batch range and every batch connects to a deliver point;
+        # parse/read links are required only for batches that name a seq
+        # (cached replays legitimately deliver with seq=None — their
+        # parse happened in a previous epoch's chain).
+        complete = (
+            h2d is not None and batches
+            and all(b["deliver"] is not None for b in batches)
+            and all(
+                b["parse"] is not None and b["read"] is not None
+                for b in batches if b["seq"] is not None
+            )
+        )
+        starts = [disp["ts"]]
+        for b in batches:
+            for k in ("read", "parse", "deliver"):
+                if b[k] is not None:
+                    starts.append(b[k]["ts"])
+        if h2d is not None:
+            starts.append(h2d["ts"])
+        if stack is not None:
+            starts.append(stack["ts"])
+        chains.append({
+            "sb": sb, "dispatch": disp, "stack": stack, "h2d": h2d,
+            "batches": batches, "complete": bool(complete),
+            "latency_us": disp["ts"] + disp.get("dur", 0) - min(starts),
+        })
+    return chains
+
+
+def _chain_segments(chain: dict) -> dict:
+    """Stage timing along one chain, for the critical-path breakdown:
+    the LAST-finishing batch's read/parse spans, the stack/h2d spans,
+    and the dispatch — plus the gaps between them."""
+    segs = {}
+    last_parse = None
+    for b in chain["batches"]:
+        if b["parse"] is not None:
+            end = b["parse"]["ts"] + b["parse"].get("dur", 0)
+            if last_parse is None or end > last_parse["ts"] + \
+                    last_parse.get("dur", 0):
+                last_parse = b["parse"]
+    for name, ev in (
+        ("parse", last_parse), ("stack", chain["stack"]),
+        ("h2d", chain["h2d"]), ("dispatch", chain["dispatch"]),
+    ):
+        if ev is not None:
+            segs[name] = (ev["ts"], ev.get("dur", 0))
+    return segs
+
+
+def trace_mode(paths: list, out: str, limit: int) -> int:
+    events, notes, per_file = merge_traces(paths)
+    if not events:
+        print("no trace events")
+        return 1
+    out = out or (paths[0] + ".merged.json")
+    with open(out, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    print(f"merged {len(paths)} file(s), {len(events)} events -> {out}")
+    print("open in https://ui.perfetto.dev (or chrome://tracing)")
+    for note in notes:
+        print(f"  ! {note}")
+    # Chains are reconstructed PER RANK FILE: sb/seq/batch ids restart
+    # per rank, so joining across the merged pool would cross-wire the
+    # ranks' super-batches.
+    chains = []
+    for evs in per_file:
+        chains.extend(trace_chains(evs))
+
+    spans: dict = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            tot, cnt, mx = spans.get(ev["name"], (0, 0, 0))
+            d = ev.get("dur", 0)
+            spans[ev["name"]] = (tot + d, cnt + 1, max(mx, d))
+    print(f"\nstage spans ({sum(c for _, c, _ in spans.values())} total):")
+    print(f"  {'span':24} {'count':>7} {'total_ms':>10} {'mean_ms':>9} "
+          f"{'max_ms':>9}")
+    for name in sorted(spans, key=lambda n: -spans[n][0]):
+        tot, cnt, mx = spans[name]
+        print(f"  {name:24} {cnt:>7} {tot / 1e3:>10.2f} "
+              f"{tot / cnt / 1e3:>9.3f} {mx / 1e3:>9.3f}")
+
+    if not chains:
+        print("\nno dispatched super-batches in this trace")
+        return 0
+    n_ok = sum(1 for c in chains if c["complete"])
+    print(f"\nsuper-batch chains: {len(chains)} dispatched, {n_ok} with "
+          f"a complete read->parse->deliver->h2d->dispatch chain")
+    if n_ok < len(chains):
+        bad = [c["sb"] for c in chains if not c["complete"]][:10]
+        print(f"  ! incomplete chains (first 10 sb ids): {bad}")
+    slowest = sorted(chains, key=lambda c: -c["latency_us"])[:limit]
+    print(f"\ncritical path — slowest {len(slowest)} chain(s) "
+          f"(end-to-end latency, first event -> dispatch done):")
+    for c in slowest:
+        segs = _chain_segments(c)
+        parts = []
+        prev_end = None
+        for name in ("parse", "stack", "h2d", "dispatch"):
+            if name not in segs:
+                continue
+            ts, dur = segs[name]
+            if prev_end is not None and ts > prev_end:
+                parts.append(f"(+{(ts - prev_end) / 1e3:.2f} gap)")
+            parts.append(f"{name} {dur / 1e3:.2f}")
+            prev_end = ts + dur
+        print(f"  sb {c['sb']:>5}: {c['latency_us'] / 1e3:9.2f} ms  "
+              f"[ms: {' -> '.join(parts)}]")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# --compare: ratio-diff two runs (metrics JSONLs or bench JSONs)
+# ---------------------------------------------------------------------------
+
+# Direction heuristics: which way is a regression?  Rates and hit
+# fractions regress when they FALL; times, losses, waits, drops regress
+# when they RISE.  Anything unclassified is shown without a flag.
+_HIGHER_BETTER = (
+    "_per_sec", "_frac", "vs_baseline", "_vs_step_only", "value",
+    "examples", "auc", "steps",
+)
+_LOWER_BETTER = (
+    "_ms", "_s", "loss", "logloss", "mse", "ingest_wait_frac",
+    "truncated_features", "out_of_range_batches", "nonfinite_steps",
+    "elapsed", "dispatch_overhead",
+)
+# Keys where the heuristic suffixes collide or mislead.
+_DIRECTION_OVERRIDES = {
+    "ingest_wait_frac": "low", "wait_input_s": "low",
+    "telemetry_on_vs_off": None, "trace_overhead": "low",
+    "ring_zero_copy_frac": "high", "prestack_hit_frac": "high",
+    "h2d_overlap_frac": "high",
+}
+
+
+def _direction(key: str):
+    if key in _DIRECTION_OVERRIDES:
+        return _DIRECTION_OVERRIDES[key]
+    for suffix in _LOWER_BETTER:
+        if key.endswith(suffix) or key == suffix:
+            return "low"
+    for suffix in _HIGHER_BETTER:
+        if key.endswith(suffix) or key == suffix:
+            return "high"
+    return None
+
+
+def _comparable_metrics(path: str) -> dict:
+    """Flatten one artifact into {key: number}.
+
+    Bench JSONs (one object with a ``metric`` key, e.g. BENCH_rN.json)
+    contribute their numeric top-level keys.  Metrics JSONLs contribute
+    the final record's attribution + health and the last train record's
+    rate/loss/auc.
+    """
+    with open(path) as f:
+        first = f.readline()
+        rest = f.read()
+    try:
+        doc = json.loads(first + rest)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "metric" in doc:  # bench JSON
+        return {
+            k: float(v) for k, v in doc.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+    groups = load(path)
+    out: dict = {}
+    final = (groups.get("final") or groups.get("heartbeat") or [{}])[-1]
+    for key in ("elapsed", "wait_input_s", "dispatch_s", "other_s",
+                "ingest_wait_frac", "truncated_features",
+                "out_of_range_batches", "examples_in", "step"):
+        if key in final:
+            out[key] = float(final[key])
+    for key, val in (final.get("health") or {}).items():
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[f"health.{key}"] = float(val)
+    if final.get("elapsed") and final.get("examples_in"):
+        out["examples_in_per_sec"] = (
+            final["examples_in"] / final["elapsed"]
+        )
+    trains = groups.get("train") or []
+    if trains:
+        last = trains[-1]
+        for key in ("examples_per_sec", "loss", "auc"):
+            if key in last:
+                out[f"train.{key}"] = float(last[key])
+    valids = groups.get("validation") or []
+    if valids:
+        last = valids[-1]
+        for key in ("loss", "auc"):
+            if key in last:
+                out[f"validation.{key}"] = float(last[key])
+    return out
+
+
+def compare_mode(path_a: str, path_b: str, threshold: float) -> int:
+    a, b = _comparable_metrics(path_a), _comparable_metrics(path_b)
+    shared = sorted(set(a) & set(b))
+    if not shared:
+        print("no comparable numeric keys shared by the two files")
+        return 1
+    print(f"comparing A={path_a}  ->  B={path_b} "
+          f"(flag threshold {threshold:.0%})")
+    print(f"  {'key':40} {'A':>12} {'B':>12} {'B/A':>8}  flag")
+    regressions = []
+    for key in shared:
+        va, vb = a[key], b[key]
+        if va == 0 and vb == 0:
+            continue
+        ratio = vb / va if va else float("inf")
+        direction = _direction(key)
+        flag = ""
+        if direction == "high" and ratio < 1 - threshold:
+            flag = "REGRESSION"
+        elif direction == "low" and ratio > 1 + threshold:
+            flag = "REGRESSION"
+        elif direction == "high" and ratio > 1 + threshold:
+            flag = "improved"
+        elif direction == "low" and ratio < 1 - threshold:
+            flag = "improved"
+        if flag == "REGRESSION":
+            regressions.append(key)
+        rs = f"{ratio:8.3f}" if ratio != float("inf") else "     inf"
+        print(f"  {key:40} {va:>12.4g} {vb:>12.4g} {rs}  {flag}")
+    if regressions:
+        print(f"\n{len(regressions)} REGRESSION(s): "
+              f"{', '.join(regressions)}")
+        return 2
+    print("\nno regressions beyond threshold")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="summarize a fast_tffm_tpu metrics/telemetry JSONL"
+        description="summarize fast_tffm_tpu metrics JSONLs, merge "
+                    "trace files, or ratio-diff two runs"
     )
     ap.add_argument("paths", nargs="+",
-                    help="metrics_file JSONL(s) written by a run; pass "
-                         "one per rank to merge a multi-host fleet")
+                    help="metrics_file JSONL(s) (one per rank to merge "
+                         "a fleet); trace JSON files with --trace; "
+                         "exactly two artifacts with --compare")
     ap.add_argument("--limit", type=int, default=8,
-                    help="train/validation rows to show (default 8)")
+                    help="train/validation rows (or slowest chains) to "
+                         "show (default 8)")
+    ap.add_argument("--trace", action="store_true",
+                    help="treat paths as Chrome-trace span files: merge "
+                         "onto one timeline and print the critical-path "
+                         "summary")
+    ap.add_argument("-o", "--out", default=None,
+                    help="--trace: merged trace output path (default "
+                         "<first>.merged.json)")
+    ap.add_argument("--compare", action="store_true",
+                    help="ratio-diff exactly two runs (metrics JSONLs "
+                         "or bench JSONs); exit 2 on regression")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="--compare: regression flag threshold "
+                         "(default 0.05 = 5%%)")
     args = ap.parse_args(argv)
+    if args.trace:
+        return trace_mode(args.paths, args.out, args.limit)
+    if args.compare:
+        if len(args.paths) != 2:
+            ap.error("--compare takes exactly two paths")
+        return compare_mode(args.paths[0], args.paths[1], args.threshold)
     streams = []
     for path in args.paths:
         groups = load(path)
