@@ -85,6 +85,18 @@ def build_argparser() -> argparse.ArgumentParser:
              "(raw windows parsed in place; 0 = pickle windows over "
              "the worker queue)",
     )
+    # Tiered embedding table knobs (override the cfg file).
+    p.add_argument(
+        "--table_tiering", choices=["off", "on"], default=None,
+        help="two-tier embedding table: device-resident hot rows over a "
+             "host-RAM cold store holding the full vocabulary (unlocks "
+             "V >= 2^28; requires the sparse update path)",
+    )
+    p.add_argument(
+        "--hot_rows", type=int, default=None,
+        help="device-resident rows when --table_tiering on (must cover "
+             "one super-batch's unique ids)",
+    )
     # Observability knobs (override the cfg file).
     p.add_argument(
         "--heartbeat_secs", type=float, default=None,
@@ -157,7 +169,7 @@ def main(argv=None) -> int:
         for key in ("steps_per_dispatch", "prefetch_super_batches",
                     "parse_processes", "cache_epochs", "cache_max_bytes",
                     "cache_prestacked", "ring_slots", "heartbeat_secs",
-                    "trace_file", "nan_policy")
+                    "trace_file", "nan_policy", "table_tiering", "hot_rows")
         if getattr(args, key) is not None
     }
     if args.no_telemetry:

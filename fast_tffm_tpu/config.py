@@ -220,6 +220,19 @@ class FmConfig:
     # background thread while n trains.  Bounds host+device memory for
     # staged input at prefetch_super_batches * steps_per_dispatch batches.
     prefetch_super_batches: int = 2
+    # Two-tier embedding table (train.tiered): "on" keeps only the
+    # hottest rows device-resident (params + optimizer slots for
+    # hot_rows rows) over a host-RAM cold store holding the full
+    # logical vocabulary_size table, with occupancy-driven LRU
+    # migration planned per super-batch in the prefetch stage.  Unlocks
+    # V >= 2^28 vocabularies that cannot exist as a dense device table;
+    # requires the sparse update path (adagrad/ftrl/sgd, batch L2) and
+    # a single process.  "off" = the classic dense device table.
+    table_tiering: str = "off"  # off | on
+    # Device-resident hot rows when table_tiering=on.  Must hold every
+    # unique id of one super-batch (steps_per_dispatch * batch_size *
+    # max_features is a safe upper bound); clamped to vocabulary_size.
+    hot_rows: int = 1 << 22
     # How multi-device sparse updates are exchanged over the data axis
     # (both the shardmap step and the GSPMD sharded tile apply; the
     # reference's IndexedSlices push, SURVEY.md §3.2): "dense" psums
@@ -280,6 +293,12 @@ class FmConfig:
             raise ValueError(
                 f"ring_slots must be >= 0, got {self.ring_slots}"
             )
+        if self.table_tiering not in ("off", "on"):
+            raise ValueError(
+                f"unknown table_tiering {self.table_tiering!r}"
+            )
+        if self.hot_rows < 1:
+            raise ValueError(f"hot_rows must be >= 1, got {self.hot_rows}")
         if self.cache_prestacked and not self.cache_epochs:
             raise ValueError(
                 "cache_prestacked requires cache_epochs (it is a storage "
@@ -381,6 +400,8 @@ _KEYMAP = {
     "cache_max_bytes": ("cache_max_bytes", int),
     "cache_prestacked": ("cache_prestacked", _parse_bool),
     "ring_slots": ("ring_slots", int),
+    "table_tiering": ("table_tiering", str),
+    "hot_rows": ("hot_rows", int),
 }
 
 

@@ -1451,6 +1451,14 @@ class _StagingPool:
             else obs.NULL.counter("")
         )
         self._tracer = tracer if tracer is not None else obs.NULL_TRACER
+        # Whether put_fn's device arrays ALIAS the host staging buffers
+        # (None = not yet probed).  jax.device_put on a single-device
+        # CPU mesh is zero-copy: the "device" array shares memory with
+        # the numpy buffer, so recycling the buffer would rewrite
+        # super-batches still queued for dispatch.  The first retire()
+        # probes once; aliasing permanently disables reuse (fresh
+        # allocations per group — correct, just not recycled).
+        self._alias_mode: Optional[bool] = None
 
     @staticmethod
     def _key(group):
@@ -1510,8 +1518,46 @@ class _StagingPool:
             return free.pop()
         return self._alloc(group, key[2])
 
+    @staticmethod
+    def _probe_alias(dev, bufs: libsvm.Batch) -> bool:
+        """True when any device-array leaf of ``dev`` shares memory with
+        a staging buffer — the zero-copy device_put case where reuse
+        would corrupt in-flight data.  Only probed on the CPU backend
+        (accelerator puts always copy across the host/device boundary);
+        errs toward True (no reuse) on any surprise."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return False
+        try:
+            if jax.default_backend() != "cpu":
+                return False
+            host = [x for x in bufs[:5]]
+            if bufs.sort_meta is not None:
+                host.extend(bufs.sort_meta)
+            for leaf in jax.tree_util.tree_leaves(dev):
+                if isinstance(leaf, jax.Array):
+                    a = np.asarray(leaf)
+                    if any(np.shares_memory(a, h) for h in host):
+                        return True
+        except Exception:  # pragma: no cover - be safe, not fast
+            return True
+        return False
+
     def retire(self, dev, group, bufs: libsvm.Batch) -> None:
         """Queue the buffers behind their device transfer for reuse."""
+        if self._alias_mode is None:
+            self._alias_mode = self._probe_alias(dev, bufs)
+            if self._alias_mode:
+                log.info(
+                    "staging-buffer reuse disabled: device_put aliases "
+                    "host memory on this backend (single-device CPU "
+                    "zero-copy), so recycling would corrupt in-flight "
+                    "super-batches; stacking allocates fresh buffers"
+                )
+        if self._alias_mode:
+            return  # the device array owns this memory now
         self._inflight.append((dev, self._key(group), bufs))
 
 

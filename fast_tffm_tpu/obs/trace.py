@@ -49,12 +49,15 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import os
 import threading
 import time
 from typing import Optional
 
 __all__ = ["Tracer", "NULL_TRACER", "SpanHandle"]
+
+log = logging.getLogger(__name__)
 
 # Backstop against unbounded growth on very long runs: ~1M events is
 # ~250 MB of JSON — far beyond what Perfetto loads comfortably anyway.
@@ -201,6 +204,15 @@ class Tracer:
                 return
             self._events.append(ev)
 
+    @property
+    def dropped_events(self) -> int:
+        """Events discarded at the buffer cap so far.  A nonzero value
+        means the trace is TRUNCATED — chains silently stop mid-run —
+        so the count is surfaced (dump() warning + the trainer's final
+        metrics record) instead of only living in the dump metadata."""
+        with self._lock:
+            return self._dropped
+
     # ------------------------------------------------------------------
     # cross-process shipping
     # ------------------------------------------------------------------
@@ -254,6 +266,13 @@ class Tracer:
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
+        if dropped:
+            log.warning(
+                "trace buffer overflowed: %d event(s) dropped past the "
+                "%d-event cap — %s is TRUNCATED (chains stop mid-run); "
+                "trace shorter runs or raise max_events",
+                dropped, self._max, path,
+            )
         doc = {
             "traceEvents": events,
             "displayTimeUnit": "ms",

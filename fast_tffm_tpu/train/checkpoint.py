@@ -55,6 +55,10 @@ def save(
         )
         if opt_state is not None:
             ckptr.save(_opt_dir(model_file), {"opt_state": opt_state}, force=True)
+    # The dense dirs are the checkpoint now; a stale tiered overlay left
+    # behind by an earlier table_tiering run must not shadow them (the
+    # tiered restore path checks the overlay FIRST).
+    clear_tiered(model_file)
     if data_state is not None:
         # Input-pipeline position for mid-epoch resume; written last so a
         # crash mid-save leaves the (older) params without a newer data
@@ -111,6 +115,99 @@ def restore_params(model_file: str, template: Any) -> tuple[Any, int]:
             restore_args=_restore_args_for(item),
         )
     return got["params"], int(got["step"])
+
+
+def _tiered_path(model_file: str) -> str:
+    return os.path.join(os.path.abspath(model_file), "tiered.npz")
+
+
+def exists_tiered(model_file: str) -> bool:
+    return os.path.isfile(_tiered_path(model_file))
+
+
+def save_tiered(
+    model_file: str,
+    step: int,
+    scalars: dict,
+    stores: dict,
+    data_state: Optional[dict] = None,
+) -> None:
+    """Sparse-overlay checkpoint for a tiered table too large to merge
+    into the dense format (train.tiered): per logical store, the ids and
+    values of every row that ever deviated from its deterministic init,
+    plus the init descriptor that regenerates the rest.  Tier-layout-
+    independent — ``hot_rows`` at restore time is free to differ.
+
+    Layout: ``<model_file>/tiered.npz`` with keys
+    ``scalar/<name>`` (w0 + optimizer w0 slots, and ``step``),
+    ``<store>/ids``, ``<store>/rows``, ``<store>/descriptor`` (JSON).
+    The dense ``params``/``opt`` dirs are removed — the overlay is now
+    the checkpoint, and a stale dense dir must not shadow it.
+    """
+    path = _tiered_path(model_file)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload: dict = {
+        "scalar/step": np.int64(step),
+        "meta/stores": np.array(json.dumps(sorted(stores))),
+    }
+    for name, val in scalars.items():
+        payload[f"scalar/{name}"] = np.asarray(val)
+    for name, store in stores.items():
+        payload[f"{name}/ids"] = store["ids"]
+        payload[f"{name}/rows"] = store["rows"]
+        payload[f"{name}/descriptor"] = np.array(
+            json.dumps(store.get("descriptor", {}), sort_keys=True)
+        )
+    tmp = path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+    # Remove the stale dense dirs LOUDLY: a dense checkpoint silently
+    # left beside a newer overlay is an ambiguity the restore guards
+    # then have to refuse (the two formats share no freshness marker).
+    for stale in (_params_dir(model_file), _opt_dir(model_file)):
+        if os.path.isdir(stale):
+            import shutil
+
+            shutil.rmtree(stale)
+    if data_state is not None:
+        dtmp = _data_state_path(model_file) + ".tmp"
+        with open(dtmp, "w") as f:
+            json.dump(data_state, f)
+        os.replace(dtmp, _data_state_path(model_file))
+    log.info("saved tiered overlay checkpoint step=%d to %s", step, path)
+
+
+def restore_tiered(model_file: str) -> Optional[tuple]:
+    """(step, scalars, stores) from a tiered overlay, or None."""
+    path = _tiered_path(model_file)
+    if not os.path.isfile(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        names = json.loads(str(z["meta/stores"]))
+        step = int(z["scalar/step"])
+        scalars = {
+            k.split("/", 1)[1]: z[k]
+            for k in z.files
+            if k.startswith("scalar/") and k != "scalar/step"
+        }
+        stores = {}
+        for name in names:
+            stores[name] = {
+                "ids": z[f"{name}/ids"],
+                "rows": z[f"{name}/rows"],
+                "descriptor": json.loads(str(z[f"{name}/descriptor"])),
+            }
+    return step, scalars, stores
+
+
+def clear_tiered(model_file: str) -> None:
+    """Remove a stale overlay after a dense-format save (the dense dirs
+    are now the checkpoint; precedence must not flip back)."""
+    try:
+        os.remove(_tiered_path(model_file))
+    except FileNotFoundError:
+        pass
 
 
 def restore_opt(model_file: str, template: Any) -> Optional[Any]:

@@ -44,6 +44,7 @@ from fast_tffm_tpu.models import fm
 from fast_tffm_tpu.parallel import mesh as mesh_lib
 from fast_tffm_tpu.train import checkpoint, metrics as metrics_lib
 from fast_tffm_tpu.train import sparse as sparse_lib
+from fast_tffm_tpu.train import tiered as tiered_lib
 from fast_tffm_tpu.train.optimizers import make_optimizer
 
 log = logging.getLogger(__name__)
@@ -397,6 +398,42 @@ class Trainer:
         else:
             self.optimizer = make_optimizer(cfg)
             self._opt_init_fn = self.optimizer.init
+        # Tiered embedding table (train.tiered): the device trains
+        # against a compact HOT table of hot_rows rows; the full logical
+        # table lives in a host-RAM cold store and rows migrate per
+        # super-batch.  Everything device-side is built from a config
+        # whose vocabulary_size is the hot-table size; ingest keeps the
+        # LOGICAL vocabulary (parsing, hashing, OOR checks are stream
+        # properties, not table-layout properties).
+        self.tiered: Optional[tiered_lib.TieredTable] = None
+        self._dcfg = cfg
+        if cfg.table_tiering == "on":
+            if not self.sparse:
+                raise ValueError(
+                    "table_tiering=on requires the sparse update path "
+                    "(optimizer in adagrad/ftrl/sgd with batch-mode L2): "
+                    "a dense optimizer rewrites every row every step, so "
+                    "there is no cold set to keep off-device"
+                )
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "table_tiering=on is single-process for now (the "
+                    "hot-slot map is host-global)"
+                )
+            if cfg.lookup == "shardmap":
+                raise ValueError(
+                    "table_tiering=on does not compose with "
+                    "lookup=shardmap yet; use lookup=auto"
+                )
+            self._dcfg = dataclasses.replace(
+                cfg, vocabulary_size=min(cfg.hot_rows, cfg.vocabulary_size)
+            )
+            if cfg.hot_rows >= cfg.vocabulary_size:
+                log.info(
+                    "table_tiering=on with hot_rows >= vocabulary_size: "
+                    "every row fits the hot table (tiering is a no-op "
+                    "beyond the remap)"
+                )
         if cfg.batch_size % self.mesh.shape[mesh_lib.DATA_AXIS] != 0:
             raise ValueError(
                 f"batch_size {cfg.batch_size} not divisible by data-mesh "
@@ -417,10 +454,14 @@ class Trainer:
         )
 
         state_sh = jax.tree.map(lambda x: x.sharding, self.state)
+        # All device-side step math is built from _dcfg: identical to cfg
+        # except that, with tiering on, vocabulary_size is the hot-table
+        # size (the step's math never reads the vocab beyond table shape).
+        dcfg = self._dcfg
         step_fn = (
-            make_sparse_train_step(cfg, self.mesh)
+            make_sparse_train_step(dcfg, self.mesh)
             if self.sparse
-            else make_train_step(cfg, self.optimizer)
+            else make_train_step(dcfg, self.optimizer)
         )
         # Visible record of the chosen execution strategy: a silent
         # fallback (e.g. interpret-mode Pallas on an unrecognized
@@ -431,7 +472,7 @@ class Trainer:
             "step build: sparse=%s apply_mode=%s interaction=%s "
             "interpret=%s backend=%s mesh=%s",
             self.sparse,
-            sparse_lib.apply_mode(cfg, self.mesh) if self.sparse else "dense",
+            sparse_lib.apply_mode(dcfg, self.mesh) if self.sparse else "dense",
             cfg.interaction_impl, use_interpret(), jax.default_backend(),
             dict(self.mesh.shape),
         )
@@ -452,12 +493,12 @@ class Trainer:
         # no health.
         self._super_batch_sh = Batch(**mesh_lib.super_batch_sharding(self.mesh))
         step_fn_health = (
-            make_sparse_train_step(cfg, self.mesh, with_health=True)
+            make_sparse_train_step(dcfg, self.mesh, with_health=True)
             if self.sparse
-            else make_train_step(cfg, self.optimizer, with_health=True)
+            else make_train_step(dcfg, self.optimizer, with_health=True)
         )
         self._health = jax.device_put(
-            HealthState.zeros(cfg.vocabulary_size), rep
+            HealthState.zeros(dcfg.vocabulary_size), rep
         )
         self._health_host: dict = {}  # last host-read health scalars
         self._health_step0 = int(self.state.step)  # run-start step base
@@ -467,7 +508,7 @@ class Trainer:
         # scalars alive for the delayed nan_policy check (a donated
         # carry would invalidate them under the next dispatch).
         self._scan_health_jit = jax.jit(
-            make_scan_train_step(step_fn_health, make_health_update(cfg)),
+            make_scan_train_step(step_fn_health, make_health_update(dcfg)),
             in_shardings=(state_sh, health_sh, self._super_batch_sh),
             out_shardings=(state_sh, health_sh),
             donate_argnums=0,
@@ -479,6 +520,37 @@ class Trainer:
             out_shardings=ms_sh,
             donate_argnums=1,
         )
+        if self.tiered is not None:
+            # Migration jits: gather the evicted slots' current rows
+            # (async D2H write-back source) and overwrite loaded slots
+            # with cold rows (the pad slot index == hot_rows scatter-
+            # drops).  Tables keep their row sharding; slot/row operands
+            # are replicated.  The load donates the old tables so the
+            # hot-table buffers are reused in place.
+            n_tab = 1 + len(tiered_lib.opt_table_names(cfg.optimizer))
+            tab_sh = (param_sh.table,) * n_tab
+
+            def _gather_fn(tables, slots):
+                return tuple(t[slots] for t in tables)
+
+            def _load_fn(tables, slots, rows):
+                return tuple(
+                    t.at[slots].set(r, mode="drop")
+                    for t, r in zip(tables, rows)
+                )
+
+            self._tier_gather_jit = jax.jit(
+                _gather_fn,
+                in_shardings=(tab_sh, rep),
+                out_shardings=(rep,) * n_tab,
+            )
+            self._tier_load_jit = jax.jit(
+                _load_fn,
+                in_shardings=(tab_sh, rep, (rep,) * n_tab),
+                out_shardings=tab_sh,
+                donate_argnums=0,
+            )
+            self._tiered_eval_jit = None  # built lazily (merged eval)
 
     def _opt_shardings(self, param_sh, params_template):
         """Sharding for each optimizer-state leaf: table-shaped accumulators
@@ -493,7 +565,20 @@ class Trainer:
         )
 
     def _init_or_restore(self, param_sh):
+        if self.cfg.table_tiering == "on":
+            return self._init_or_restore_tiered(param_sh)
         cfg = self.cfg
+        if checkpoint.exists_tiered(cfg.model_file):
+            # Refuse loudly rather than silently cold-starting over (or
+            # preferring possibly-stale dense dirs beside) a tiered
+            # overlay: the two formats carry no shared freshness marker,
+            # and the overlay holds a table too large to restore densely.
+            raise ValueError(
+                f"{cfg.model_file} holds a tiered overlay checkpoint "
+                "(written by table_tiering=on at a vocabulary too large "
+                "for the dense format); resume it with table_tiering=on, "
+                "or point model_file somewhere fresh to train dense"
+            )
         template = _params_template(cfg, param_sh)
         opt_sh = self._opt_shardings(param_sh, template)
         opt_init = jax.jit(self._opt_init_fn, out_shardings=opt_sh)
@@ -519,6 +604,126 @@ class Trainer:
         init = jax.jit(partial(fm.init_params, cfg=cfg), out_shardings=param_sh)
         params = init(jax.random.PRNGKey(cfg.seed))
         return params, opt_init(params)
+
+    def _init_or_restore_tiered(self, param_sh):
+        """Build the HOT device state + the host-side TieredTable.
+
+        The hot tables' initial values are placeholders: a slot only
+        influences training after a migration load overwrites it with
+        its cold row, so any deterministic init works.  The checkpoint
+        of record is the LOGICAL table: a tiered overlay
+        (checkpoint.restore_tiered) when present, else the ordinary
+        dense checkpoint restored to host numpy and used to seed the
+        cold store — so a tiered run resumes from a dense run's
+        checkpoint (and vice versa, via the merged dense save) with any
+        hot_rows.
+        """
+        cfg, dcfg = self.cfg, self._dcfg
+        rep = NamedSharding(self.mesh, P())
+        template = _params_template(dcfg, param_sh)
+        opt_sh = self._opt_shardings(param_sh, template)
+        opt_init = jax.jit(self._opt_init_fn, out_shardings=opt_sh)
+        init = jax.jit(
+            partial(fm.init_params, cfg=dcfg), out_shardings=param_sh
+        )
+        params = init(jax.random.PRNGKey(cfg.seed))
+
+        def put_scalar(x):
+            return jax.device_put(jnp.asarray(x, jnp.float32), rep)
+
+        overlay = checkpoint.restore_tiered(cfg.model_file)
+        if overlay is not None:
+            step, scalars, stores = overlay
+            self._restored_step = step
+            log.info(
+                "warm-starting tiered table from overlay checkpoint %s "
+                "(step %d)", cfg.model_file, step,
+            )
+            self.tiered = tiered_lib.TieredTable(
+                cfg, telemetry=self.telemetry, overlay=stores
+            )
+            params = params._replace(w0=put_scalar(scalars["w0"]))
+            opt_state = tiered_lib.set_opt_scalars(
+                cfg.optimizer, opt_init(params), scalars, put_scalar
+            )
+            return params, opt_state
+        if checkpoint.exists(cfg.model_file):
+            log.info(
+                "warm-starting tiered table from dense checkpoint %s",
+                cfg.model_file,
+            )
+            # Restore to HOST numpy at the logical shape (templates
+            # without shardings), never materializing on device.
+            np_tmpl = jax.eval_shape(
+                partial(fm.init_params, cfg=cfg), jax.random.PRNGKey(0)
+            )
+            np_params, self._restored_step = checkpoint.restore_params(
+                cfg.model_file, np_tmpl
+            )
+            np_params = fm.FmParams(*np_params)
+            opt_np = checkpoint.restore_opt(
+                cfg.model_file, jax.eval_shape(self._opt_init_fn, np_tmpl)
+            )
+            if opt_np is not None and cfg.optimizer == "ftrl":
+                # Same contract as the dense path's _check_ftrl_invariant:
+                # the sparse FTRL applies rely on w == ftrl_solve(z, n),
+                # so a table edited outside train.sparse is loudly
+                # normalized before it seeds the cold store.
+                np_params = self._ftrl_normalize_np(np_params, opt_np)
+            dense_tables = {"table": np.asarray(np_params.table)}
+            params = params._replace(w0=put_scalar(np_params.w0))
+            # Scalar (w0) optimizer slots: restored when present, else
+            # derived from the restored w0 — the same thing the dense
+            # path's opt_init-on-restored-params does.
+            opt_state = opt_init(params)
+            if opt_np is not None:
+                for name, tab in zip(
+                    tiered_lib.opt_table_names(cfg.optimizer),
+                    tiered_lib.get_opt_tables(cfg.optimizer, opt_np),
+                ):
+                    dense_tables[name] = np.asarray(tab)
+                opt_state = tiered_lib.set_opt_scalars(
+                    cfg.optimizer, opt_state,
+                    tiered_lib.get_opt_scalars(cfg.optimizer, opt_np),
+                    put_scalar,
+                )
+            self.tiered = tiered_lib.TieredTable(
+                cfg, telemetry=self.telemetry, dense_tables=dense_tables
+            )
+            return params, opt_state
+        self._restored_step = 0
+        self.tiered = tiered_lib.TieredTable(cfg, telemetry=self.telemetry)
+        return params, opt_init(params)
+
+    def _ftrl_normalize_np(self, np_params, opt_np):
+        """Host-side mirror of :meth:`_check_ftrl_invariant` for the
+        tiered warm start (the restored table lives in host numpy on its
+        way into the cold store, never on device)."""
+        cfg = self.cfg
+        solve = partial(
+            sparse_lib.sparse_apply.ftrl_solve,
+            lr=cfg.learning_rate, l1=cfg.ftrl_l1, l2=cfg.ftrl_l2,
+            beta=cfg.ftrl_beta,
+        )
+        expect = fm.FmParams(
+            w0=np.asarray(solve(jnp.asarray(opt_np.z.w0),
+                                jnp.asarray(opt_np.n.w0))),
+            table=np.asarray(solve(jnp.asarray(opt_np.z.table),
+                                   jnp.asarray(opt_np.n.table))),
+        )
+        dev = max(
+            float(np.max(np.abs(expect.w0 - np.asarray(np_params.w0)))),
+            float(np.max(np.abs(expect.table - np.asarray(np_params.table)))),
+        )
+        if dev <= 1e-6:
+            return np_params
+        log.warning(
+            "warm-started FTRL params violate w == ftrl_solve(z, n) "
+            "(max |dev| %.3g) — the table was edited outside "
+            "train.sparse.  Normalizing before seeding the tiered cold "
+            "store, matching the dense restore path.", dev,
+        )
+        return expect
 
     def _check_ftrl_invariant(self, params, opt_state):
         """Enforce the FTRL closed-form invariant on a warm start.
@@ -583,7 +788,7 @@ class Trainer:
         every other record carries."""
         rep = NamedSharding(self.mesh, P())
         self._health = jax.device_put(
-            HealthState.zeros(self.cfg.vocabulary_size), rep
+            HealthState.zeros(self._dcfg.vocabulary_size), rep
         )
         self._health_step0 = int(self.state.step)
 
@@ -602,7 +807,7 @@ class Trainer:
                 step0 = getattr(self, "_health_step0", 0)
                 steps = max(1, int(self.state.step) - step0)
                 rows = int(jnp.sum(h.rows_touched))
-                vocab = self.cfg.vocabulary_size
+                vocab = self._dcfg.vocabulary_size
                 first_nf = int(h.first_nonfinite_step)
                 out.update({
                     "grad_norm": round(
@@ -620,6 +825,11 @@ class Trainer:
                     "emb_row_occupancy": round(rows / vocab, 6),
                     "emb_touch_events": float(h.touch_events),
                 })
+                if self.tiered is not None:
+                    # The scan-carry mask counts HOT SLOTS under
+                    # tiering; the manager sees every logical id
+                    # host-side and overrides with logical occupancy.
+                    out.update(self.tiered.health_view())
                 self._health_host = dict(out)
             except Exception:  # pragma: no cover - wedged device
                 pass  # crash path: serve whatever was cached
@@ -653,13 +863,74 @@ class Trainer:
                 self._meta_spec = None
         return mesh_lib.shard_batch(batch, self.mesh)
 
-    def _put_super(self, batch: Batch) -> Batch:
+    def _put_super(self, batch: Batch):
         """Ship a stacked [K, ...] super-batch — DevicePrefetcher's put_fn,
         called from the transfer thread so the H2D copies overlap the
         previous super-batch's training.  Host sort_meta is attached by
         the pipeline workers (sort_meta_spec); no fallback computation
-        here — a meta-less stack trains through the device-sort path."""
-        return mesh_lib.shard_super_batch(batch, self.mesh)
+        here — a meta-less stack trains through the device-sort path.
+
+        With tiering on, this is where migration happens: the batch's
+        logical ids are remapped to hot-slot indices (allocating slots
+        for misses, fetching their cold rows) and the migration plan's
+        device halves ship on the same async H2D path as the batch —
+        the dispatch loop receives a :class:`tiered_lib.Shipment`.
+        """
+        if self.tiered is None:
+            return mesh_lib.shard_super_batch(batch, self.mesh)
+        new_ids, plan = self.tiered.plan(batch.ids)
+        batch = batch._replace(ids=new_ids, sort_meta=None)
+        dev = mesh_lib.shard_super_batch(batch, self.mesh)
+        rep = NamedSharding(self.mesh, P())
+        return tiered_lib.Shipment(
+            batch=dev,
+            load_slots=jax.device_put(plan.load_slots, rep),
+            load_rows=tuple(
+                jax.device_put(r, rep) for r in plan.load_rows
+            ),
+            evict_slots=jax.device_put(plan.evict_slots, rep),
+            load_slots_h=plan.load_slots,
+            load_ids=plan.load_ids,
+            plan_id=plan.plan_id,
+            n_load=plan.n_load,
+            n_evict=plan.n_evict,
+        )
+
+    def _apply_migration(self, shipment: tiered_lib.Shipment) -> Batch:
+        """Apply one super-batch's migration plan to the hot tables.
+
+        Runs in the dispatch loop BETWEEN dispatches, so device-stream
+        order guarantees correctness: the eviction gather reads the
+        post-previous-dispatch row values (async D2H; consumed one-plus
+        dispatches later by the cold store), and the load overwrite
+        lands before the dispatch that needs the new rows.  Returns the
+        device super-batch to dispatch.
+        """
+        man = self.tiered
+        state = self.state
+        tables = (state.params.table,) + tiered_lib.get_opt_tables(
+            self.cfg.optimizer, state.opt_state
+        )
+        if shipment.n_evict:
+            rows = self._tier_gather_jit(tables, shipment.evict_slots)
+            for r in rows:
+                try:
+                    r.copy_to_host_async()
+                except Exception:  # pragma: no cover - backend drift
+                    pass
+            man.push_writeback(shipment.plan_id, rows)
+        if shipment.n_load:
+            new_tables = self._tier_load_jit(
+                tables, shipment.load_slots, shipment.load_rows
+            )
+            self.state = state._replace(
+                params=state.params._replace(table=new_tables[0]),
+                opt_state=tiered_lib.set_opt_tables(
+                    self.cfg.optimizer, state.opt_state, new_tables[1:]
+                ),
+            )
+            man.note_applied(shipment)
+        return shipment.batch
 
     def _sort_meta_spec(self):
         """(vocab, CHUNK, TILE) when host-side sort prep applies, else None.
@@ -675,6 +946,7 @@ class Trainer:
         cfg = self.cfg
         if (
             cfg.host_sort
+            and self.tiered is None  # sort prep keys on pre-remap ids
             and jax.process_count() == 1
             and self.mesh.size == 1
         ):
@@ -791,6 +1063,10 @@ class Trainer:
                 "cache_epochs": cfg.cache_epochs,
                 "cache_prestacked": cfg.cache_prestacked,
                 "ring_slots": cfg.ring_slots,
+                "table_tiering": cfg.table_tiering,
+                "hot_rows": (
+                    cfg.hot_rows if cfg.table_tiering == "on" else 0
+                ),
                 "batch_size": cfg.batch_size,
                 "epoch_num": cfg.epoch_num,
                 "optimizer": cfg.optimizer,
@@ -827,6 +1103,8 @@ class Trainer:
         # device and its scalars are already on the host.
         self._reset_health()
         self._health_host = {}
+        if self.tiered is not None:
+            self.tiered.reopen()  # re-arm after a cancelled prior run
         pending_health = None  # (nonfinite_arr, grad_sq_arr, stepno)
         nonfinite_warned = False
 
@@ -863,6 +1141,10 @@ class Trainer:
         # heartbeat derives ingest_wait_frac = wait / wall from these.
         t_wait = self.telemetry.timer("train.wait_input")
         t_disp = self.telemetry.timer("train.dispatch")
+        # Tiered-table migration time (eviction gather enqueue + load
+        # apply): part of "other" in the wall split — the H2D of the
+        # cold rows themselves already overlapped in the prefetcher.
+        t_migr = self.telemetry.timer("train.migrate")
         # Cadences move to super-batch (K-step) granularity: a trigger
         # fires at the first dispatch boundary where at least its period
         # of NEW steps has elapsed since it last fired.  At K == 1 this
@@ -949,7 +1231,7 @@ class Trainer:
             now = time.time()
             wall = max(now - t0, 1e-9)
             wait_s, disp_s = t_wait.total_s, t_disp.total_s
-            return {
+            rec = {
                 "record": kind,
                 "time": now,
                 "step": stepno,
@@ -971,6 +1253,16 @@ class Trainer:
                 "health": self._health_summary(exact=(kind == "final")),
                 "stages": self.telemetry.snapshot(),
             }
+            if self.tiered is not None:
+                # Hot/cold cache behavior (host-side counters only —
+                # safe from the heartbeat thread).
+                rec["tiered"] = self.tiered.snapshot()
+            if kind == "final" and self.tracer.enabled:
+                # Truncation truthfulness: a trace that hit the event
+                # cap silently lies by omission; the count rides the
+                # final record so report tooling can flag it.
+                rec["trace_dropped_events"] = self.tracer.dropped_events
+            return rec
 
         heartbeat = None
         if cfg.heartbeat_secs > 0:
@@ -1012,6 +1304,17 @@ class Trainer:
                             )
                         continue
                     super_batch, kk = item
+                    if self.tiered is not None:
+                        # Migration first: eviction gather reads the
+                        # previous dispatch's row values, the load lands
+                        # before this dispatch gathers its rows.
+                        with t_migr.time(), self.tracer.span(
+                            "train.migrate",
+                            args={"sb": dispatch_idx,
+                                  "loads": super_batch.n_load,
+                                  "evicts": super_batch.n_evict},
+                        ):
+                            super_batch = self._apply_migration(super_batch)
                     if (
                         cfg.profile_dir
                         and not profile_started
@@ -1164,6 +1467,13 @@ class Trainer:
             finally:
                 if heartbeat is not None:
                     heartbeat.close()
+                if self.tiered is not None:
+                    # Wake a transfer thread blocked on a write-back
+                    # fill that will never come — prefetcher.close()
+                    # joins that thread, and an untimed cv wait would
+                    # deadlock shutdown under nan_policy=halt /
+                    # KeyboardInterrupt / validation errors.
+                    self.tiered.cancel_waits()
                 prefetcher.close()
             self._epoch = cfg.epoch_num
             self._batches_done = 0
@@ -1236,6 +1546,10 @@ class Trainer:
         train_metrics["health"] = dict(
             self._final_record.get("health", {})
         )
+        if self.tiered is not None:
+            train_metrics["tiered"] = dict(
+                self._final_record.get("tiered", {})
+            )
         self.save(stepno)
         result = {"train": train_metrics}
         if cfg.validation_files:
@@ -1255,6 +1569,25 @@ class Trainer:
             files, pipe_cfg, epochs=1, shuffle=False, shard=shard,
             ordered=ordered,
         )
+        if self.tiered is not None:
+            # Evaluation scores against the MERGED logical table (cold
+            # rows included — evaluation must not be blind to rows that
+            # happen to be cold right now).  Small logical tables merge
+            # densely; huge-V virtual stores score each batch against a
+            # compact per-batch table instead (no dense table ever
+            # materializes).
+            if self._tiered_eval_jit is None:
+                self._tiered_eval_jit = jax.jit(
+                    make_eval_step(self.cfg), donate_argnums=1
+                )
+            if not self.tiered.dense_save_ok:
+                return self._evaluate_tiered_virtual(pipeline, ms)
+            params = self._tiered_logical_params()
+            for batch in pipeline:
+                ms = self._tiered_eval_jit(
+                    params, ms, self._put(batch, want_meta=False)
+                )
+            return _finalize_metrics(ms, self.cfg.loss_type)
         for batch in pipeline:
             ms = self._eval_step(
                 self.state.params, ms, self._put(batch, want_meta=False)
@@ -1287,17 +1620,114 @@ class Trainer:
             fp["steps_per_dispatch"] = self.cfg.steps_per_dispatch
         return fp
 
+    def _evaluate_tiered_virtual(self, pipeline, ms) -> dict:
+        """Huge-V tiered evaluation: sync the hot rows back once, then
+        score every eval batch against a COMPACT per-batch table — the
+        batch's unique rows gathered from the cold store, ids remapped
+        to local indices.  Same math as a full-table gather (row values
+        are identical), without ever materializing [V, D].  No new
+        dispatches run during evaluation, so the synced cold store is a
+        consistent snapshot."""
+        self.tiered.sync_from_device(self._hot_host_tables())
+        rep = NamedSharding(self.mesh, P())
+        w0 = jax.device_put(self.state.params.w0, rep)
+        vocab = self.cfg.vocabulary_size
+        dim = self.cfg.embedding_dim
+        for batch in pipeline:
+            flat = batch.ids.reshape(-1)
+            safe = np.where((flat >= 0) & (flat < vocab), flat, 0)
+            u, inv = np.unique(safe, return_inverse=True)
+            # Bucket-pad the compact table so the eval jit retraces
+            # O(log) times, not once per distinct unique count.
+            mp = tiered_lib._bucket(len(u))
+            mini = np.zeros((mp, dim), np.float32)
+            mini[:len(u)] = self.tiered.gather_logical(u)
+            params = fm.FmParams(
+                w0=w0, table=jax.device_put(mini, rep)
+            )
+            b = batch._replace(
+                ids=inv.astype(np.int32).reshape(batch.ids.shape)
+            )
+            ms = self._tiered_eval_jit(
+                params, ms, self._put(b, want_meta=False)
+            )
+        return _finalize_metrics(ms, self.cfg.loss_type)
+
+    def _hot_host_tables(self) -> list:
+        """np copies of the current device hot tables (params first),
+        ordered like the manager's stores.  Blocks until the device is
+        caught up — only called from checkpoint/eval paths."""
+        tabs = (self.state.params.table,) + tiered_lib.get_opt_tables(
+            self.cfg.optimizer, self.state.opt_state
+        )
+        return [np.asarray(t) for t in tabs]
+
+    def _tiered_logical_params(self) -> fm.FmParams:
+        """The merged logical params (hot written back over cold) as a
+        replicated device FmParams — the eval/predict view of a tiered
+        table.  Only feasible when the logical table materializes
+        densely (small V); huge-V tiered runs score via the training
+        path, not a merged table."""
+        merged = self.tiered.merged_dense(self._hot_host_tables())
+        rep = NamedSharding(self.mesh, P())
+        return fm.FmParams(
+            w0=jax.device_put(self.state.params.w0, rep),
+            table=jax.device_put(merged[0], rep),
+        )
+
     def save(self, stepno: int):
-        checkpoint.save(
-            self.cfg.model_file,
-            self._restored_step + stepno,
-            self.state.params,
-            self.state.opt_state,
-            data_state={
-                "epoch": self._epoch,
-                "batches_done": self._batches_done,
-                "fingerprint": self._data_fingerprint(),
-            },
+        data_state = {
+            "epoch": self._epoch,
+            "batches_done": self._batches_done,
+            "fingerprint": self._data_fingerprint(),
+        }
+        if self.tiered is None:
+            checkpoint.save(
+                self.cfg.model_file,
+                self._restored_step + stepno,
+                self.state.params,
+                self.state.opt_state,
+                data_state=data_state,
+            )
+            return
+        # Tiered: the checkpoint of record is the LOGICAL table.  Small
+        # logical tables merge into the ordinary dense format (dense and
+        # tiered runs interchange checkpoints freely, any hot_rows);
+        # larger ones save the sparse overlay (tier-layout-independent,
+        # tiered-restore only).
+        cfg = self.cfg
+        step = self._restored_step + stepno
+        host_tables = self._hot_host_tables()
+        w0 = np.asarray(self.state.params.w0)
+        opt_scalars = tiered_lib.get_opt_scalars(
+            cfg.optimizer, self.state.opt_state
+        )
+        if self.tiered.dense_save_ok:
+            merged = self.tiered.merged_dense(host_tables)
+            params = fm.FmParams(w0=w0, table=merged[0])
+            if cfg.optimizer == "sgd":
+                opt_state = ()
+            else:
+                # The device opt pytree with its table/w0 leaves swapped
+                # for the merged logical numpy arrays.
+                opt_state = tiered_lib.set_opt_tables(
+                    cfg.optimizer,
+                    tiered_lib.set_opt_scalars(
+                        cfg.optimizer, self.state.opt_state, opt_scalars,
+                        np.asarray,
+                    ),
+                    tuple(merged[1:]),
+                )
+            checkpoint.save(
+                cfg.model_file, step, params, opt_state,
+                data_state=data_state,
+            )  # checkpoint.save clears any stale overlay itself
+            return
+        scalars = {"w0": w0, **opt_scalars}
+        checkpoint.save_tiered(
+            cfg.model_file, step, scalars,
+            self.tiered.export_overlay(host_tables),
+            data_state=data_state,
         )
 
 
@@ -1314,6 +1744,13 @@ def predict(cfg: FmConfig, mesh=None) -> int:
             "predict runs single-process (the reference scored on one "
             "worker too); run it without jax.distributed — the sharded "
             "checkpoint restores fine on fewer devices"
+        )
+    if checkpoint.exists_tiered(cfg.model_file):
+        raise NotImplementedError(
+            "this checkpoint is a tiered sparse overlay "
+            "(table_tiering=on at a vocabulary too large to merge "
+            "densely); predict needs a dense-format checkpoint — score "
+            "through a tiered Trainer instead (see EMBEDDING.md)"
         )
     mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
     param_sh = mesh_lib.param_sharding(mesh)

@@ -1,0 +1,555 @@
+"""Tiered embedding table (ISSUE 6 tentpole): device-resident hot rows
+over a host-RAM cold store, occupancy-driven migration.
+
+The pinned guarantees:
+
+  * parity — tiered training is ELEMENT-WISE IDENTICAL to dense training
+    at small V (merged logical table, loss, auc), for Adagrad and FTRL,
+    across K-step dispatch, eviction churn, and multi-epoch streams;
+  * resume — checkpoints are tier-layout-independent: dense <-> tiered
+    and tiered(H1) -> tiered(H2) warm starts continue bit-identically,
+    including mid-epoch positions; the huge-V sparse overlay format
+    round-trips exactly;
+  * mechanics — LRU eviction never evicts the current super-batch's
+    rows, the pending write-back ledger serves re-fetched rows, OOR ids
+    keep the dense path's silently-dropped-update contract, and a
+    too-small hot table fails loudly.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.libsvm import Batch
+from fast_tffm_tpu.data.pipeline import stack_batches
+from fast_tffm_tpu.models import fm
+from fast_tffm_tpu.train import checkpoint, tiered
+from fast_tffm_tpu.train.loop import Trainer
+
+V = 256
+
+
+def _write_data(path, rng, lines=256, vocab=V):
+    with open(path, "w") as f:
+        for i in range(lines):
+            f.write(
+                f"{i % 2} {rng.integers(0, vocab)}:1 "
+                f"{rng.integers(0, vocab)}:0.5 "
+                f"{rng.integers(0, vocab)}:0.25\n"
+            )
+
+
+def _cfg(tmp_path, model, **kw):
+    defaults = dict(
+        vocabulary_size=V, factor_num=4, max_features=4, batch_size=32,
+        train_files=[str(tmp_path / "train.libsvm")],
+        model_file=str(tmp_path / model),
+        epoch_num=2, log_steps=0, thread_num=1, seed=3,
+        steps_per_dispatch=2,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+def _dense_table(model_file, cfg):
+    from functools import partial
+
+    tmpl = jax.eval_shape(
+        partial(fm.init_params, cfg=cfg), jax.random.PRNGKey(0)
+    )
+    params, step = checkpoint.restore_params(model_file, tmpl)
+    return np.asarray(params[1]), np.asarray(params[0]), step
+
+
+def _merged(trainer):
+    return trainer.tiered.merged_dense(trainer._hot_host_tables())
+
+
+# ------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("optimizer", ["adagrad", "ftrl"])
+@pytest.mark.parametrize("hot_rows", [V, 160])
+def test_tiered_matches_dense_elementwise(tmp_path, rng, optimizer,
+                                          hot_rows):
+    """Tiered == dense: merged logical table bitwise, loss/auc exact —
+    with (hot_rows < V forces eviction churn) and without evictions."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    rd = Trainer(_cfg(tmp_path, "dense", optimizer=optimizer)).train()
+    t = Trainer(_cfg(
+        tmp_path, "tiered", optimizer=optimizer,
+        table_tiering="on", hot_rows=hot_rows,
+    ))
+    rt = t.train()
+    assert rt["train"]["loss"] == rd["train"]["loss"]
+    assert rt["train"]["auc"] == rd["train"]["auc"]
+    d_table, d_w0, _ = _dense_table(str(tmp_path / "dense"),
+                                    _cfg(tmp_path, "x"))
+    merged = _merged(t)
+    np.testing.assert_array_equal(merged[0], d_table)
+    np.testing.assert_array_equal(
+        np.asarray(t.state.params.w0), d_w0
+    )
+    snap = rt["train"]["tiered"]
+    if hot_rows < V:
+        assert snap["rows_evicted"] > 0  # churn actually exercised
+    assert snap["hit_occurrences"] + snap["miss_occurrences"] > 0
+    assert 0.0 < snap["hot_hit_frac"] < 1.0
+
+
+def test_tiered_opt_state_matches_dense(tmp_path, rng):
+    """The optimizer slot tables migrate with the params: merged adagrad
+    accumulators equal the dense run's bitwise.  save_steps exercises
+    the MID-RUN checkpoint path (merge while plans are in flight)."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    Trainer(_cfg(tmp_path, "dense", save_steps=4)).train()
+    t = Trainer(_cfg(
+        tmp_path, "tiered", table_tiering="on", hot_rows=160,
+        save_steps=4,
+    ))
+    t.train()
+    cfg = _cfg(tmp_path, "x")
+    from functools import partial
+
+    tmpl = jax.eval_shape(
+        partial(fm.init_params, cfg=cfg), jax.random.PRNGKey(0)
+    )
+    from fast_tffm_tpu.train import sparse as sparse_lib
+
+    opt_tmpl = jax.eval_shape(
+        partial(sparse_lib.init_sparse_opt_state, cfg), tmpl
+    )
+    opt_np = checkpoint.restore_opt(str(tmp_path / "dense"), opt_tmpl)
+    merged = _merged(t)
+    np.testing.assert_array_equal(merged[1], np.asarray(opt_np.acc.table))
+    np.testing.assert_array_equal(
+        np.asarray(t.state.opt_state.acc.w0), np.asarray(opt_np.acc.w0)
+    )
+
+
+def test_tiered_metrics_and_validation_match_dense(tmp_path, rng):
+    """Validation runs against the MERGED logical table — cold rows
+    included — and matches the dense run exactly."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    _write_data(tmp_path / "valid.libsvm", np.random.default_rng(9),
+                lines=64)
+    kw = dict(validation_files=[str(tmp_path / "valid.libsvm")])
+    rd = Trainer(_cfg(tmp_path, "dense", **kw)).train()
+    rt = Trainer(_cfg(
+        tmp_path, "tiered", table_tiering="on", hot_rows=160, **kw
+    )).train()
+    assert rt["validation"]["loss"] == rd["validation"]["loss"]
+    assert rt["validation"]["auc"] == rd["validation"]["auc"]
+
+
+# ------------------------------------------------------------- resume
+
+
+@pytest.mark.parametrize("optimizer", ["adagrad", "ftrl"])
+def test_resume_across_tier_layout_change(tmp_path, rng, optimizer):
+    """Checkpoints are tier-layout-independent: dense -> tiered(H1) ->
+    tiered(H2) -> dense warm-start chains all land on the same params
+    as an all-dense chain (each train() on a completed checkpoint
+    trains epoch_num fresh epochs)."""
+    _write_data(tmp_path / "train.libsvm", rng)
+
+    def chain(model, layouts):
+        for layout in layouts:
+            kw = dict(optimizer=optimizer, epoch_num=1, model_file=str(
+                tmp_path / model
+            ))
+            if layout is not None:
+                kw.update(table_tiering="on", hot_rows=layout)
+            t = Trainer(_cfg(tmp_path, model, **kw))
+            t.train()
+        return t
+
+    chain("all_dense", [None, None, None])
+    t = chain("mixed", [None, 192, 160])  # dense -> H=192 -> H=160
+    d_table, d_w0, d_step = _dense_table(
+        str(tmp_path / "all_dense"), _cfg(tmp_path, "x")
+    )
+    m_table, m_w0, m_step = _dense_table(
+        str(tmp_path / "mixed"), _cfg(tmp_path, "x")
+    )
+    assert m_step == d_step == 24  # 3 chained 1-epoch runs, 8 steps each
+    np.testing.assert_array_equal(m_table, d_table)
+    np.testing.assert_array_equal(m_w0, d_w0)
+    # ... and the final tiered trainer's own merged view agrees.
+    np.testing.assert_array_equal(_merged(t)[0], d_table)
+
+
+def test_tiered_mid_epoch_resume_matches_dense(tmp_path, rng):
+    """A mid-epoch interruption resumed under a DIFFERENT tier layout
+    retrains the same remaining batches as the dense resume."""
+    from tests.conftest import set_data_state
+
+    _write_data(tmp_path / "train.libsvm", rng)
+    for model, kw1, kw2 in (
+        ("dense", {}, {}),
+        ("tiered", dict(table_tiering="on", hot_rows=192),
+         dict(table_tiering="on", hot_rows=160)),
+    ):
+        cfg1 = _cfg(tmp_path, model, epoch_num=1, **kw1)
+        Trainer(cfg1).train()
+        set_data_state(cfg1.model_file, epoch=0, batches_done=4)
+        t2 = Trainer(_cfg(tmp_path, model, epoch_num=1, **kw2))
+        assert t2._restored_step == 8
+        r2 = t2.train()
+        assert r2["train"]["steps"] == 4  # only the remaining batches
+    d_table, _, d_step = _dense_table(str(tmp_path / "dense"),
+                                      _cfg(tmp_path, "x"))
+    t_table, _, t_step = _dense_table(str(tmp_path / "tiered"),
+                                      _cfg(tmp_path, "x"))
+    assert t_step == d_step == 12
+    np.testing.assert_array_equal(t_table, d_table)
+
+
+def test_overlay_checkpoint_roundtrip(tmp_path, rng, monkeypatch):
+    """The sparse overlay format (huge-V tiered checkpoints): forcing
+    the virtual cold store at tiny V, a save -> restore across a
+    hot_rows change continues training deterministically, and the
+    overlay supersedes any stale dense checkpoint dirs."""
+    monkeypatch.setattr(tiered, "EXACT_BYTES_MAX", 0)  # force virtual
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg1 = _cfg(tmp_path, "m", epoch_num=1, table_tiering="on",
+                hot_rows=192)
+    t1 = Trainer(cfg1)
+    r1 = t1.train()
+    assert checkpoint.exists_tiered(cfg1.model_file)
+    assert not checkpoint.exists(cfg1.model_file)  # dense dirs removed
+    step, scalars, stores = checkpoint.restore_tiered(cfg1.model_file)
+    assert step == 8 and "w0" in scalars and "table" in stores
+    assert len(stores["table"]["ids"]) > 0
+    # Resume with a different hot size: continues from the overlay.
+    t2 = Trainer(_cfg(tmp_path, "m", epoch_num=1, table_tiering="on",
+                      hot_rows=160))
+    assert t2._restored_step == 8
+    r2 = t2.train()
+    assert r2["train"]["steps"] == 8
+    # Reference: the same two-run chain through the EXACT (dense-backed)
+    # store must produce different bits (virtual init differs by design)
+    # but the virtual chain must agree with ITSELF when replayed.
+    t3 = Trainer(_cfg(tmp_path, "m2", epoch_num=1, table_tiering="on",
+                      hot_rows=192))
+    t3.train()
+    t4 = Trainer(_cfg(tmp_path, "m2", epoch_num=1, table_tiering="on",
+                      hot_rows=160))
+    t4.train()
+    a = checkpoint.restore_tiered(str(tmp_path / "m"))
+    b = checkpoint.restore_tiered(str(tmp_path / "m2"))
+    np.testing.assert_array_equal(a[2]["table"]["ids"],
+                                  b[2]["table"]["ids"])
+    np.testing.assert_array_equal(a[2]["table"]["rows"],
+                                  b[2]["table"]["rows"])
+
+
+def test_virtual_store_validation_matches_manual_scoring(
+    tmp_path, rng, monkeypatch
+):
+    """Huge-V (virtual cold store) evaluation: no dense merge exists,
+    so eval scores each batch against a compact per-batch table — and
+    the result must equal scoring with the full reconstructed table."""
+    from fast_tffm_tpu.data.pipeline import BatchPipeline
+    from fast_tffm_tpu.parallel import mesh as mesh_lib
+    from fast_tffm_tpu.train.loop import (
+        MetricState, _finalize_metrics, make_eval_step,
+    )
+
+    monkeypatch.setattr(tiered, "EXACT_BYTES_MAX", 0)  # force virtual
+    _write_data(tmp_path / "train.libsvm", rng)
+    _write_data(tmp_path / "valid.libsvm", np.random.default_rng(9),
+                lines=64)
+    cfg = _cfg(tmp_path, "m", table_tiering="on", hot_rows=192,
+               validation_files=[str(tmp_path / "valid.libsvm")])
+    t = Trainer(cfg)
+    r = t.train()
+    # Reference: reconstruct the full logical table row-by-row from the
+    # same cold store (V is tiny here) and score the stream directly.
+    t.tiered.sync_from_device(t._hot_host_tables())
+    table = t.tiered.gather_logical(np.arange(V, dtype=np.int64))
+    step = jax.jit(make_eval_step(cfg))
+    ms = MetricState.zeros()
+    params = fm.FmParams(
+        w0=np.asarray(t.state.params.w0), table=table
+    )
+    for batch in BatchPipeline(cfg.validation_files, cfg, epochs=1,
+                               shuffle=False, ordered=True):
+        ms = step(params, ms, mesh_lib.shard_batch(batch, t.mesh))
+    expect = _finalize_metrics(ms, cfg.loss_type)
+    assert r["validation"]["loss"] == expect["loss"]
+    assert r["validation"]["auc"] == expect["auc"]
+
+
+def test_dense_trainer_refuses_overlay_checkpoint(tmp_path, rng,
+                                                  monkeypatch):
+    """A dense trainer pointed at a tiered-overlay-only checkpoint must
+    refuse loudly, not silently cold-start over it; and a dense save
+    clears a stale overlay so precedence can't flip back."""
+    monkeypatch.setattr(tiered, "EXACT_BYTES_MAX", 0)  # force overlay
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, "m", epoch_num=1, table_tiering="on",
+               hot_rows=192)
+    Trainer(cfg).train()
+    assert checkpoint.exists_tiered(cfg.model_file)
+    with pytest.raises(ValueError, match="tiered overlay"):
+        Trainer(_cfg(tmp_path, "m", epoch_num=1))
+    # BOTH formats present (crash-window debris) is ambiguous — the two
+    # carry no shared freshness marker — so the dense path refuses too.
+    import shutil
+
+    cfg2 = _cfg(tmp_path, "m2", epoch_num=1)
+    Trainer(cfg2).train()
+    shutil.copy(f"{cfg.model_file}/tiered.npz",
+                f"{cfg2.model_file}/tiered.npz")
+    with pytest.raises(ValueError, match="tiered overlay"):
+        Trainer(cfg2)
+    # Clearing the debris restores the dense flow, and a dense save
+    # leaves no overlay behind.
+    checkpoint.clear_tiered(cfg2.model_file)
+    Trainer(cfg2).train()
+    assert checkpoint.exists(cfg2.model_file)
+    assert not checkpoint.exists_tiered(cfg2.model_file)
+
+
+def test_cold_store_tail_compaction_ordering():
+    """Repeated scatters to overlapping ids: the newest write wins
+    through the write tail, across compactions, and in export."""
+    cfg = FmConfig(vocabulary_size=1 << 20, factor_num=2,
+                   table_tiering="on", hot_rows=64, seed=7)
+    store = tiered._virtual_store(cfg, "table")
+    dim = cfg.embedding_dim
+    ids = np.arange(10, dtype=np.int64)
+    for round_ in range(5):
+        store.scatter(ids, np.full((10, dim), float(round_), np.float32))
+        np.testing.assert_array_equal(
+            store.gather(ids), np.full((10, dim), float(round_))
+        )
+    store._compact()
+    np.testing.assert_array_equal(
+        store.gather(ids), np.full((10, dim), 4.0)
+    )
+    assert len(store._ids) == 10  # deduped, newest kept
+    exp = store.export()
+    np.testing.assert_array_equal(exp["ids"], ids)
+    np.testing.assert_array_equal(exp["rows"], np.full((10, dim), 4.0))
+
+
+def test_overlay_descriptor_mismatch_raises(tmp_path, rng, monkeypatch):
+    """An overlay saved under a different seed must refuse to load: the
+    non-materialized rows would silently regenerate differently."""
+    monkeypatch.setattr(tiered, "EXACT_BYTES_MAX", 0)
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg1 = _cfg(tmp_path, "m", epoch_num=1, table_tiering="on",
+                hot_rows=192)
+    Trainer(cfg1).train()
+    with pytest.raises(ValueError, match="different init"):
+        Trainer(_cfg(tmp_path, "m", epoch_num=1, table_tiering="on",
+                     hot_rows=192, seed=99))
+
+
+# ------------------------------------------------------------- mechanics
+
+
+def test_hot_rows_too_small_raises(tmp_path, rng):
+    """A super-batch whose unique ids outgrow the hot table fails with
+    an actionable error (surfaced through the prefetcher)."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    t = Trainer(_cfg(tmp_path, "m", table_tiering="on", hot_rows=16))
+    with pytest.raises(RuntimeError, match="hot_rows"):
+        t.train()
+
+
+def test_tiering_requires_sparse_path(tmp_path):
+    with pytest.raises(ValueError, match="sparse update path"):
+        Trainer(_cfg(tmp_path, "m", table_tiering="on", optimizer="adam"))
+
+
+def test_plan_remap_and_oor_contract(rng):
+    """TieredTable.plan unit semantics: remap is a bijection on present
+    ids, padding id 0 stays mapped, and out-of-range ids map to the
+    hot-table size (device scatter drops them — the dense contract)."""
+    cfg = FmConfig(vocabulary_size=64, factor_num=2, max_features=4,
+                   table_tiering="on", hot_rows=32)
+    man = tiered.TieredTable(cfg)
+    ids = np.array([[0, 5, 9, 5], [70, 9, 0, 63]], np.int32)  # 70 OOR
+    new_ids, plan = man.plan(ids)
+    assert new_ids.shape == ids.shape
+    assert new_ids[1, 0] == 32  # OOR -> hot_rows (dropped on device)
+    # bijection: equal logical ids -> equal slots, distinct -> distinct
+    m = {}
+    for lg, sl in zip(ids.reshape(-1), new_ids.reshape(-1)):
+        if lg >= 64:
+            continue
+        assert m.setdefault(int(lg), int(sl)) == int(sl)
+    assert len(set(m.values())) == len(m)
+    assert plan.n_load == len(m)
+    snap = man.snapshot()
+    assert snap["oor_occurrences"] == 1
+    assert snap["resident_rows"] == len(m)
+
+
+def test_plan_lru_never_evicts_current_superbatch(rng):
+    """Eviction picks least-recently-used slots and never a slot the
+    current super-batch (or this plan's fresh loads) occupies."""
+    cfg = FmConfig(vocabulary_size=64, factor_num=2, max_features=2,
+                   table_tiering="on", hot_rows=8)
+    man = tiered.TieredTable(cfg)
+    _, p1 = man.plan(np.arange(0, 6, dtype=np.int32).reshape(1, -1))
+    assert p1.n_evict == 0
+    # 4 new ids: 2 fresh slots remain, 2 evictions — must come from
+    # ids 0..5 (LRU), never from the new ids' own fresh slots.
+    _, p2 = man.plan(np.arange(6, 10, dtype=np.int32).reshape(1, -1))
+    assert p2.n_load == 4 and p2.n_evict == 2
+    resident = {int(i) for i in man.id_of_slot if i >= 0}
+    assert {6, 7, 8, 9} <= resident
+    assert len(resident) == 8
+    # Write-back entry exists for the evicted ids and a re-fetch is
+    # served from it once the dispatch loop hands the rows over.
+    evicted = {0, 1, 2, 3, 4, 5} - resident
+    assert len(evicted) == 2
+    rows = tuple(
+        np.full((tiered._bucket(p2.n_evict), cfg.embedding_dim),
+                7.5, np.float32)
+        for _ in man.names
+    )
+    man.push_writeback(p2.plan_id, rows)
+    eid = sorted(evicted)[0]
+    _, p3 = man.plan(np.array([[eid, 6]], np.int32))
+    assert p3.n_load == 1
+    np.testing.assert_array_equal(
+        p3.load_rows[0][0], np.full(cfg.embedding_dim, 7.5, np.float32)
+    )
+
+
+def test_cancel_waits_releases_blocked_writeback_wait():
+    """A transfer thread blocked waiting for a write-back fill that will
+    never come (the dispatch loop died) must be released by
+    cancel_waits() — otherwise prefetcher.close()'s join deadlocks the
+    whole shutdown path under nan_policy=halt / KeyboardInterrupt."""
+    import threading
+    import time as _time
+
+    cfg = FmConfig(vocabulary_size=64, factor_num=2, max_features=2,
+                   table_tiering="on", hot_rows=8)
+    man = tiered.TieredTable(cfg)
+    man.plan(np.arange(0, 6, dtype=np.int32).reshape(1, -1))
+    _, p2 = man.plan(np.arange(6, 10, dtype=np.int32).reshape(1, -1))
+    assert p2.n_evict == 2  # pending entry created, never filled
+    evicted = sorted({0, 1, 2, 3, 4, 5}
+                     - {int(i) for i in man.id_of_slot if i >= 0})
+    outcome: list = []
+
+    def refetch():
+        try:
+            man.plan(np.array([[evicted[0], 6]], np.int32))
+            outcome.append("returned")
+        except RuntimeError as e:
+            outcome.append(str(e))
+
+    worker = threading.Thread(target=refetch, daemon=True)
+    worker.start()
+    _time.sleep(0.2)
+    assert worker.is_alive()  # blocked on the never-coming fill
+    man.cancel_waits()
+    worker.join(timeout=5)
+    assert not worker.is_alive()
+    assert outcome and "cancelled" in outcome[0]
+    # reopen() re-arms the manager for the next run.
+    man.reopen()
+    assert man._cancelled is False
+
+
+def test_cold_store_gather_scatter_roundtrip():
+    """Virtual cold store: deterministic row init, sparse overlay
+    read-your-writes, export/import roundtrip."""
+    cfg = FmConfig(vocabulary_size=1 << 20, factor_num=4,
+                   table_tiering="on", hot_rows=64, seed=11)
+    import fast_tffm_tpu.train.tiered as tl
+
+    store = tl._virtual_store(cfg, "table")
+    ids = np.array([3, 999_999, 12345], np.int64)
+    a = store.gather(ids)
+    b = store.gather(ids)
+    np.testing.assert_array_equal(a, b)  # deterministic init
+    assert np.abs(a).max() <= cfg.init_value_range
+    wrote = np.full((2, cfg.embedding_dim), 0.25, np.float32)
+    store.scatter(ids[:2], wrote)
+    got = store.gather(ids)
+    np.testing.assert_array_equal(got[:2], wrote)
+    np.testing.assert_array_equal(got[2], a[2])  # untouched row = init
+    fresh = tl._virtual_store(cfg, "table")
+    fresh.import_overlay(store.export())
+    np.testing.assert_array_equal(fresh.gather(ids), got)
+    assert store.nbytes < 1 << 12  # sparse: bytes track written rows
+
+
+def test_run_header_and_results_carry_tiering(tmp_path, rng):
+    """Observability: run_header names the tiering mode, heartbeat/final
+    records and train results carry the hot/cold counters."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    cfg = _cfg(tmp_path, "m", table_tiering="on", hot_rows=192,
+               metrics_file=str(tmp_path / "metrics.jsonl"))
+    r = Trainer(cfg).train()
+    snap = r["train"]["tiered"]
+    occ = snap["hit_occurrences"] + snap["miss_occurrences"]
+    assert occ == 2 * 256 * 4  # 2 epochs x 256 lines x max_features
+    assert snap["hot_hit_frac"] == pytest.approx(
+        snap["hit_occurrences"] / occ, abs=1e-6
+    )
+    assert snap["rows_loaded"] >= snap["resident_rows"]
+    recs = [json.loads(line) for line in
+            open(tmp_path / "metrics.jsonl")]
+    header = [x for x in recs if x["record"] == "run_header"][0]
+    assert header["table_tiering"] == "on"
+    assert header["hot_rows"] == 192
+    final = [x for x in recs if x["record"] == "final"][0]
+    assert final["tiered"]["hot_hit_frac"] == snap["hot_hit_frac"]
+    # Logical (not hot-slot) occupancy in the health record.
+    assert final["health"]["emb_rows_touched"] == snap["rows_seen"]
+
+
+def test_staging_pool_disables_reuse_when_put_aliases():
+    """The pre-existing hazard the tiered work exposed: on a backend
+    where device_put ALIASES host memory (single-device CPU zero-copy),
+    recycling staging buffers would rewrite in-flight super-batches.
+    The pool must detect aliasing on first retire and stop recycling."""
+    from fast_tffm_tpu.data.pipeline import _StagingPool
+
+    pool = _StagingPool(1)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return Batch(
+            labels=rng.random(4, np.float32),
+            ids=rng.integers(0, 8, (4, 2)).astype(np.int32),
+            vals=rng.random((4, 2), np.float32),
+            fields=np.zeros((4, 2), np.int32),
+            weights=np.ones(4, np.float32),
+        )
+
+    group = [batch(), batch()]
+    bufs = pool.acquire(group)
+    stacked = stack_batches(group, out=bufs)
+    # A single-device put on CPU aliases the host buffer.
+    dev = jax.tree.map(
+        lambda x: jax.device_put(x, jax.devices()[0]), stacked
+    )
+    aliased = any(
+        np.shares_memory(np.asarray(d), h)
+        for d, h in zip(jax.tree.leaves(dev), jax.tree.leaves(stacked))
+    )
+    pool.retire(dev, group, bufs)
+    if aliased:
+        assert pool._alias_mode is True
+        # acquire must hand out FRESH buffers now, never bufs again.
+        bufs2 = pool.acquire(group)
+        assert bufs2.ids is not bufs.ids
+    else:  # pragma: no cover - backend copied; contract already safe
+        assert pool._alias_mode is False

@@ -106,6 +106,27 @@ class TestTracer:
         doc = json.load(open(path))
         assert doc["otherData"]["dropped_events"] == 7
 
+    def test_cap_overflow_warns_at_dump_and_exposes_count(
+        self, tmp_path, caplog
+    ):
+        """Silent truncation is a lie by omission: past the cap, dump()
+        must WARN and the dropped count must be queryable."""
+        tr = obs.Tracer(enabled=True, max_events=3)
+        for i in range(10):
+            tr.point(f"e{i}")
+        assert tr.dropped_events == 7
+        with caplog.at_level("WARNING", logger="fast_tffm_tpu.obs.trace"):
+            tr.dump(str(tmp_path / "t.json"))
+        assert any("TRUNCATED" in r.message for r in caplog.records)
+        # A clean dump stays quiet.
+        caplog.clear()
+        tr2 = obs.Tracer(enabled=True)
+        tr2.point("a")
+        with caplog.at_level("WARNING", logger="fast_tffm_tpu.obs.trace"):
+            tr2.dump(str(tmp_path / "t2.json"))
+        assert not caplog.records
+        assert tr2.dropped_events == 0
+
     def test_reset_preserves_process_name(self):
         tr = obs.Tracer(enabled=True, process_name="trainer")
         tr.point("a")
@@ -312,6 +333,34 @@ class TestTraceContent:
         rh = traced_procs_run["result"]["train"]["health"]
         assert rh["nonfinite_steps"] == 0
         assert rh["emb_rows_touched"] == health["emb_rows_touched"]
+
+    def test_final_record_surfaces_trace_dropped_events(
+        self, traced_procs_run, train_file, tmp_path
+    ):
+        """The final metrics record carries ``trace_dropped_events`` on
+        traced runs — 0 for a healthy run, the true drop count for a
+        run that overflowed the event cap."""
+        recs = [json.loads(l) for l in open(traced_procs_run["metrics"])]
+        final = [r for r in recs if r.get("record") == "final"][-1]
+        assert final["trace_dropped_events"] == 0
+        # Overflowed run: shrink the live tracer's cap before training.
+        metrics = str(tmp_path / "m.jsonl")
+        cfg = _cfg(train_file, tmp_path, "capped",
+                   trace_file=str(tmp_path / "t.json"),
+                   metrics_file=metrics)
+        trainer = Trainer(cfg)
+        trainer.tracer._max = 5
+        trainer.train()
+        recs = [json.loads(l) for l in open(metrics)]
+        final = [r for r in recs if r.get("record") == "final"][-1]
+        assert final["trace_dropped_events"] > 0
+        # An untraced run's final record carries no trace field at all.
+        cfg2 = _cfg(train_file, tmp_path, "untraced",
+                    metrics_file=str(tmp_path / "m2.jsonl"))
+        Trainer(cfg2).train()
+        recs = [json.loads(l) for l in open(str(tmp_path / "m2.jsonl"))]
+        final = [r for r in recs if r.get("record") == "final"][-1]
+        assert "trace_dropped_events" not in final
 
 
 class TestTraceOff:

@@ -156,6 +156,21 @@ def _print_breakdown(rec: dict) -> None:
         if health.get("nonfinite_steps", 0):
             print("  !! non-finite gradients occurred — the model is "
                   "numerically unhealthy (see nan_policy)")
+    if rec.get("trace_dropped_events"):
+        print(f"\n  !! trace TRUNCATED: {rec['trace_dropped_events']} "
+              "event(s) dropped at the buffer cap — chains stop mid-run")
+    tiered = rec.get("tiered") or {}
+    if tiered:
+        print("\ntiered embedding table (hot/cold migration):")
+        for key in ("hot_rows", "vocab", "resident_rows", "rows_seen",
+                    "hot_hit_frac", "hit_occurrences", "miss_occurrences",
+                    "rows_loaded", "rows_evicted", "writeback_rows",
+                    "oor_occurrences", "cold_store_bytes"):
+            if key in tiered:
+                print(f"  {key:22s} {tiered[key]}")
+        if tiered.get("hot_hit_frac", 1.0) < 0.9:
+            print("  !! hot-set hit fraction is low — the hot table is "
+                  "churning; consider raising hot_rows")
     stages = rec.get("stages") or {}
     timers = stages.get("timers") or {}
     if timers:
@@ -525,6 +540,11 @@ _DIRECTION_OVERRIDES = {
     "telemetry_on_vs_off": None, "trace_overhead": "low",
     "ring_zero_copy_frac": "high", "prestack_hit_frac": "high",
     "h2d_overlap_frac": "high",
+    # Tiered table: a FALLING hot-set hit fraction is the regression
+    # (the *_frac rise-is-bad heuristic points the wrong way here).
+    "tiered.hot_hit_frac": "high",
+    "tiered.rows_evicted": None, "tiered.rows_loaded": None,
+    "trace_dropped_events": "low",
 }
 
 
@@ -571,6 +591,12 @@ def _comparable_metrics(path: str) -> dict:
     for key, val in (final.get("health") or {}).items():
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             out[f"health.{key}"] = float(val)
+    for key in ("hot_hit_frac", "rows_evicted", "rows_loaded"):
+        val = (final.get("tiered") or {}).get(key)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[f"tiered.{key}"] = float(val)
+    if "trace_dropped_events" in final:
+        out["trace_dropped_events"] = float(final["trace_dropped_events"])
     if final.get("elapsed") and final.get("examples_in"):
         out["examples_in_per_sec"] = (
             final["examples_in"] / final["elapsed"]

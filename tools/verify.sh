@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-command correctness gate: the static tier-1 marker audit plus the
+# PINNED tier-1 pytest invocation from ROADMAP.md — builders and bench
+# preflight run the exact same thing, so "it passed locally" and "the
+# gate passed" can never mean different commands.
+#
+#   tools/verify.sh            # audit + full tier-1 suite
+#   tools/verify.sh --audit    # audit only (milliseconds, no jax)
+#
+# Exit: 0 = audit ok and tier-1 pytest exit 0; nonzero otherwise.  The
+# DOTS_PASSED line at the end is the machine-readable passed count the
+# driver compares against the recorded baseline.
+
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 marker audit (tools/check_tier1.py) =="
+python tools/check_tier1.py --tests tests --root . || exit 1
+
+if [ "${1:-}" = "--audit" ]; then
+    exit 0
+fi
+
+echo
+echo "== tier-1 pytest (pinned invocation from ROADMAP.md) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit $rc
