@@ -340,7 +340,7 @@ def _bench_parse_only(files, cfg) -> float:
 
 def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
                k: int = 1, telemetry_enabled: bool = True,
-               tracer=None) -> tuple:
+               tracer=None, status: bool = False) -> tuple:
     """Examples/sec through BatchPipeline + DevicePrefetcher — the
     train() hot path: parse threads, the stacking/H2D transfer thread,
     and the K-step fused dispatch all overlapped.  ``warmup`` counts
@@ -370,13 +370,52 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     span layer through the pipeline + prefetcher + this loop's
     wait/dispatch — the trace-overhead probe runs the identical e2e
     with it attached and compares rates.
+
+    ``status=True`` attaches a live obs.StatusServer serving this
+    run's telemetry snapshot AND a scraper thread hitting ``/metrics``
+    every 200 ms — the endpoint-overhead probe (endpoint on + scraped
+    vs off) under a realistic Prometheus-ish cadence.
     """
+    import threading
+
     from fast_tffm_tpu import obs
     from fast_tffm_tpu.data.pipeline import (
         BatchPipeline, DevicePrefetcher, EpochEnd,
     )
 
     tel = obs.Telemetry(enabled=telemetry_enabled)
+    status_server = None
+    scrape_stop = threading.Event()
+    scraper = None
+
+    def _start_status():
+        # Called inside the try below so a pipeline/prefetcher
+        # construction failure cannot leak the server + scraper into
+        # the rest of the bench (they would keep scraping a dead
+        # probe's registry and perturb every later timing).
+        nonlocal status_server, scraper
+        import urllib.request
+
+        status_server = obs.StatusServer(
+            0,
+            lambda: {
+                "record": "status",
+                "time": time.time(),
+                "stages": tel.snapshot(),
+            },
+            telemetry=tel,
+        )
+
+        def _scrape():
+            url = f"http://127.0.0.1:{status_server.port}/metrics"
+            while not scrape_stop.wait(0.2):
+                try:
+                    urllib.request.urlopen(url, timeout=2).read()
+                except Exception:  # noqa: BLE001 - probe must not die
+                    pass
+
+        scraper = threading.Thread(target=_scrape, daemon=True)
+        scraper.start()
     tracer = tracer if tracer is not None else obs.NULL_TRACER
     t_wait = tel.timer("train.wait_input")
     t_disp = tel.timer("train.dispatch")
@@ -414,6 +453,8 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     it = iter(prefetcher)
     epoch_rates: dict[int, float] = {}
     try:
+        if status:
+            _start_status()
         warmed = 0
         # sb label counts from the first super-batch CONSUMED, warmup
         # included, so the trace's train.dispatch args.sb stays aligned
@@ -459,6 +500,11 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
         _drain(trainer.state)
         dt = time.perf_counter() - t0
     finally:
+        scrape_stop.set()
+        if scraper is not None:
+            scraper.join()
+        if status_server is not None:
+            status_server.close()
         prefetcher.close()
     epoch0 = epoch_rates.get(0, 0.0)
     replays = [r for e, r in epoch_rates.items() if e > 0]
@@ -706,6 +752,7 @@ def main() -> int:
     tele_report = None
     e2e_tel_off = 0.0
     e2e_trace_on, trace_events = 0.0, 0
+    e2e_status_on = 0.0
     bf16_rung, bf16_errors = None, []
     e2e_err = None
     cfg = None
@@ -872,6 +919,21 @@ def main() -> int:
                         ladder_errors.append(
                             f"trace probe: {type(e).__name__}: {e}"
                         )
+                    # Status-endpoint overhead probe (same shape as the
+                    # telemetry/trace probes): the identical K=8 e2e
+                    # with the live /metrics endpoint up AND scraped
+                    # every 200 ms.  status_endpoint_overhead = off/on
+                    # rate ratio; budget <= 1.05 like the other layers.
+                    try:
+                        e2e_status_on, _, _, _, _ = _bench_e2e(
+                            trainer, cfg, files, warmup=4,
+                            epochs=epochs, k=K, status=True,
+                        )
+                    except Exception as e:  # noqa: BLE001 - report only
+                        ladder_errors.append(
+                            f"status endpoint probe: "
+                            f"{type(e).__name__}: {e}"
+                        )
                     # parse_processes scaling: drain the bare pipeline
                     # with thread workers vs a spawned process pool on
                     # the same files (no training attached).
@@ -995,6 +1057,14 @@ def main() -> int:
             e2e_rate / e2e_trace_on, 4
         ) if e2e_trace_on > 0 and e2e_rate > 0 else 0.0,
         "trace_events_recorded": trace_events,
+        # Status-endpoint overhead: the same K=8 e2e with the live
+        # /metrics endpoint up and scraped every 200 ms.  off/on rate
+        # ratio; budget <= 1.05 (endpoint requests only read the
+        # thread-safe snapshots, so ~1.0 = free).
+        "e2e_status_on_examples_per_sec": round(e2e_status_on, 1),
+        "status_endpoint_overhead": round(
+            e2e_rate / e2e_status_on, 4
+        ) if e2e_status_on > 0 and e2e_rate > 0 else 0.0,
         "parse_lines_per_sec": round(parse_rate, 1),
         # Bare-pipeline drain rates: thread workers vs a spawned
         # parse-process pool on the same files (GIL-free scaling probe).

@@ -122,6 +122,33 @@ def build_argparser() -> argparse.ArgumentParser:
         help="on a non-finite (NaN/inf) gradient: warn and keep "
              "counting, or halt without overwriting the checkpoint",
     )
+    p.add_argument(
+        "--status_port", type=int, default=None,
+        help="serve a live status endpoint on this port: /metrics "
+             "(Prometheus text of every telemetry snapshot + "
+             "health/tiered blocks) and /status (the heartbeat JSON "
+             "record on demand); read-only, off the hot path (0 = off)",
+    )
+    p.add_argument(
+        "--status_host", default=None, metavar="ADDR",
+        help="bind address for --status_port (default 127.0.0.1; the "
+             "endpoint is unauthenticated, so 0.0.0.0 — serving a "
+             "remote Prometheus — is an explicit opt-in)",
+    )
+    p.add_argument(
+        "--alert_rules", default=None, metavar="RULES",
+        help="alert watchdog rules riding the heartbeat, e.g. "
+             "'ingest_wait_frac > 0.5 for 3 : warn; "
+             "grad_norm_drift > 10 : halt' — breaches emit "
+             "`record: alert` JSONL entries; halt stops the run "
+             "without overwriting the checkpoint",
+    )
+    p.add_argument(
+        "--trace_rotate_events", type=int, default=None,
+        help="rotate the trace buffer into trace.0.json, trace.1.json, "
+             "... every N events (removes the in-memory cap for long "
+             "traced runs; merge with tools/report.py --trace)",
+    )
     # Legacy reference flags (mapped, SURVEY.md §3.2).
     p.add_argument("--ps_hosts", default=None, help="legacy; ps tasks exit")
     p.add_argument("--worker_hosts", default=None,
@@ -169,7 +196,9 @@ def main(argv=None) -> int:
         for key in ("steps_per_dispatch", "prefetch_super_batches",
                     "parse_processes", "cache_epochs", "cache_max_bytes",
                     "cache_prestacked", "ring_slots", "heartbeat_secs",
-                    "trace_file", "nan_policy", "table_tiering", "hot_rows")
+                    "trace_file", "nan_policy", "table_tiering", "hot_rows",
+                    "status_port", "status_host", "alert_rules",
+                    "trace_rotate_events")
         if getattr(args, key) is not None
     }
     if args.no_telemetry:

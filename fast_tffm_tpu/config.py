@@ -164,6 +164,34 @@ class FmConfig:
     # carries the totals); "halt" raises NonFiniteGradError without
     # overwriting the checkpoint with poisoned params.
     nan_policy: str = "warn"
+    # Live status endpoint (obs.StatusServer): serve /metrics
+    # (Prometheus text exposition of every telemetry snapshot + the
+    # health/tiered blocks) and /status (the heartbeat JSON record, on
+    # demand) from an in-process stdlib HTTP server on this port.
+    # 0 = off (no server exists; training is bit-identical).  The
+    # endpoint is read-only and never touches the hot path — requests
+    # read the same thread-safe snapshots a heartbeat does.
+    status_port: int = 0
+    # Bind address for the status endpoint.  Loopback by default: the
+    # endpoint is unauthenticated, so serving other hosts (a real
+    # Prometheus scrape) is an explicit opt-in ("0.0.0.0").
+    status_host: str = "127.0.0.1"
+    # Declarative alert watchdog riding the heartbeat thread (needs
+    # heartbeat_secs > 0): ';'-separated rules of the form
+    # "signal > threshold [for N] : warn|halt" evaluated against every
+    # heartbeat record (signals: any record path like ingest_wait_frac
+    # / health.grad_norm / tiered.hot_hit_frac, plus derived
+    # grad_norm_drift, beat_gap_s, prefetch_out_empty_frac — see
+    # OBSERVABILITY.md).  Breaches emit `record: alert` JSONL entries;
+    # action halt raises AlertHaltError at the next dispatch boundary
+    # without overwriting the checkpoint.  "" = off.
+    alert_rules: str = ""
+    # Windowed trace rotation: when the tracer's buffer reaches this
+    # many events it dumps and resets, producing trace.0.json,
+    # trace.1.json, ... (merge with tools/report.py --trace) — removes
+    # the in-memory event cap for multi-hour traced runs.  0 = off
+    # (single trace_file, 1M-event cap).  Requires trace_file.
+    trace_rotate_events: int = 0
 
     # --- [Tpu] (new; not in reference) ---
     # Max features per example; batches are padded to this static shape.
@@ -285,6 +313,38 @@ class FmConfig:
             )
         if self.nan_policy not in ("warn", "halt"):
             raise ValueError(f"unknown nan_policy {self.nan_policy!r}")
+        if not 0 <= self.status_port < 65536:
+            raise ValueError(
+                f"status_port must be in [0, 65535], got {self.status_port}"
+            )
+        if self.trace_rotate_events < 0:
+            raise ValueError(
+                "trace_rotate_events must be >= 0, got "
+                f"{self.trace_rotate_events}"
+            )
+        if self.trace_rotate_events and not self.trace_file:
+            raise ValueError(
+                "trace_rotate_events requires trace_file (it is a "
+                "storage policy of the trace output)"
+            )
+        if self.alert_rules:
+            # Parse at construction so a typo'd rule fails the run at
+            # startup, not silently at the first heartbeat.  The obs
+            # module is stdlib-only, so this import is cheap and safe
+            # here.
+            from fast_tffm_tpu.obs.alerts import parse_rules
+
+            parse_rules(self.alert_rules)
+            # The watchdog rides the heartbeat thread: rules without a
+            # heartbeat would NEVER evaluate — for a halt rule that is
+            # a safety mechanism silently inert, the one config bug
+            # the alert module must never allow.  Fail at startup.
+            if self.heartbeat_secs <= 0:
+                raise ValueError(
+                    "alert_rules requires heartbeat_secs > 0 (the "
+                    "watchdog evaluates rules on the heartbeat "
+                    "thread; without one no rule would ever fire)"
+                )
         if self.cache_max_bytes <= 0:
             raise ValueError(
                 f"cache_max_bytes must be positive, got {self.cache_max_bytes}"
@@ -380,6 +440,10 @@ _KEYMAP = {
     "heartbeat_secs": ("heartbeat_secs", float),
     "trace_file": ("trace_file", str),
     "nan_policy": ("nan_policy", str),
+    "status_port": ("status_port", int),
+    "status_host": ("status_host", str),
+    "alert_rules": ("alert_rules", str),
+    "trace_rotate_events": ("trace_rotate_events", int),
     "max_features": ("max_features", int),
     "mesh_data": ("mesh_data", int),
     "mesh_model": ("mesh_model", int),

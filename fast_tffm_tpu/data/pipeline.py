@@ -540,6 +540,18 @@ class BatchPipeline:
         the trainer surfaces it in train results and the final record."""
         return self._oor_counter.value
 
+    def stats(self) -> dict:
+        """Point-in-time data-integrity snapshot: the counters every
+        self-report (heartbeat, final record, /status endpoint) carries.
+        Thread-safe and callable at any time, including after shutdown —
+        the live status endpoint reads it from HTTP handler threads
+        while the pipeline runs."""
+        return {
+            "truncated_features": int(self.truncated_features),
+            "out_of_range_batches": int(self.oor_batches),
+            "ingest_cache": self.cache_result,
+        }
+
     def __iter__(self) -> Iterator:
         E, e0 = self.epochs, self.start_epoch
         if not self._cache_epochs:

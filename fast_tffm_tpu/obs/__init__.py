@@ -1,4 +1,5 @@
-"""Observability layer: telemetry, heartbeat, and causal batch tracing.
+"""Observability layer: telemetry, heartbeat, tracing, and the live
+observability plane (status endpoint + alert watchdog).
 
 ``obs.Telemetry`` is the shared instrument registry (counters, gauges,
 ring-buffer timings) every pipeline stage writes into; ``obs.NULL`` is
@@ -6,12 +7,20 @@ the always-safe disabled registry; ``obs.trace_span`` names host phases
 in xprof traces; ``obs.Heartbeat``/``obs.JsonlWriter`` turn a running
 train into a self-reporting JSONL stream; ``obs.Tracer`` /
 ``obs.NULL_TRACER`` record Chrome-trace (Perfetto-loadable) spans from
-every stage, correlated per batch/super-batch (trace.py).  See
-telemetry.py for the shared design constraints (thread-safety,
-near-zero hot-path overhead, no jax or numpy imports).
+every stage, correlated per batch/super-batch (trace.py), with windowed
+rotation for multi-hour runs; ``obs.StatusServer`` serves ``/metrics``
+(Prometheus) + ``/status`` (heartbeat JSON) live from a running
+process (status.py); ``obs.AlertEngine`` evaluates declarative alert
+rules against the heartbeat stream (alerts.py).  See telemetry.py for
+the shared design constraints (thread-safety, near-zero hot-path
+overhead, no jax or numpy imports).
 """
 
+from fast_tffm_tpu.obs.alerts import (
+    AlertEngine, AlertHaltError, AlertRule, parse_rules,
+)
 from fast_tffm_tpu.obs.heartbeat import Heartbeat, JsonlWriter
+from fast_tffm_tpu.obs.status import StatusServer, render_prometheus
 from fast_tffm_tpu.obs.telemetry import (
     NULL, Counter, DepthHist, Gauge, Telemetry, Timing, trace_span,
 )
@@ -20,4 +29,6 @@ from fast_tffm_tpu.obs.trace import NULL_TRACER, Tracer
 __all__ = [
     "Counter", "Gauge", "Timing", "DepthHist", "Telemetry", "NULL",
     "trace_span", "Heartbeat", "JsonlWriter", "Tracer", "NULL_TRACER",
+    "StatusServer", "render_prometheus",
+    "AlertEngine", "AlertHaltError", "AlertRule", "parse_rules",
 ]

@@ -1,0 +1,206 @@
+"""In-process status endpoint: the run's self-reports, readable LIVE.
+
+The heartbeat stream (heartbeat.py) and the final record answer "what
+happened" after the fact; an always-on streaming trainer or a serving
+host needs the same answers WHILE it runs, from standard tooling.
+:class:`StatusServer` is that surface: a lightweight stdlib HTTP server
+(ThreadingHTTPServer on a daemon thread) serving
+
+- ``/metrics`` — Prometheus text exposition (text/plain; version 0.0.4)
+  of every Counter / Gauge / Timing / DepthHist snapshot plus the
+  ``health`` and ``tiered`` blocks and the record's own scalars
+  (``ingest_wait_frac``, ``step``, ...), ready for a Prometheus scrape;
+- ``/status`` — the same JSON record a heartbeat would emit, built on
+  demand (``record: status``);
+- ``/healthz`` — liveness probe (200 ``ok`` while the run is alive).
+
+Design constraints, shared with the rest of ``obs/``:
+
+- stdlib only (no jax, no numpy) — the builder callable owns anything
+  heavier;
+- read-only and off the hot path: every request calls the owner's
+  ``build()`` (the trainer's heartbeat-record builder), which reads
+  thread-safe snapshots and host-cached health scalars only — never a
+  device readback, never a lock the hot path holds across work;
+- zero cost when disabled: the server only exists when ``status_port``
+  is set; nothing else changes, so training with it unset is
+  bit-identical.
+
+Request handling runs on the server's own threads; the only shared
+mutable state it touches is the telemetry registry's lock-guarded
+snapshots (and an optional ``status.requests`` counter so scrape load
+is itself observable).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+__all__ = ["StatusServer", "render_prometheus"]
+
+log = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a dotted instrument name into a Prometheus metric name
+    (``ingest.out_q_depth`` -> ``ingest_out_q_depth``)."""
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def render_prometheus(record: dict) -> str:
+    """Render one heartbeat-shaped record as Prometheus text exposition.
+
+    Layout (all names prefixed ``tffm_``):
+
+    - record scalars -> gauges (``tffm_step``, ``tffm_ingest_wait_frac``);
+    - ``stages.counters`` -> ``tffm_counter_<name>_total`` counters;
+    - ``stages.gauges`` -> ``tffm_gauge_<name>`` gauges;
+    - ``stages.timers`` -> ``tffm_timer_<name>_count`` /
+      ``_seconds_total`` counters + ``_p50_ms``/``_p95_ms``/``_max_ms``
+      /``_mean_ms`` gauges (the percentiles describe the recent ring —
+      see telemetry.Timing);
+    - ``stages.depths`` -> ``tffm_depth_<name>_events_total`` /
+      ``_mean`` / ``_max`` plus per-band ``_bucket{band="1-3"}`` gauges
+      (occupancy bands, not cumulative ``le`` buckets);
+    - ``health.*`` -> ``tffm_health_<key>`` gauges;
+    - ``tiered.*`` -> ``tffm_tiered_<key>`` gauges.
+    """
+    lines: list = []
+
+    def emit(name: str, value, mtype: str = "gauge", help_: str = "",
+             labels: str = "") -> None:
+        if not _num(value):
+            return
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name}{labels} {value}")
+
+    for key, val in record.items():
+        if _num(val):
+            emit(f"tffm_{_prom_name(key)}", val,
+                 help_="record scalar from the live status snapshot")
+    stages = record.get("stages") or {}
+    for name, val in sorted((stages.get("counters") or {}).items()):
+        emit(f"tffm_counter_{_prom_name(name)}_total", val, "counter")
+    for name, val in sorted((stages.get("gauges") or {}).items()):
+        emit(f"tffm_gauge_{_prom_name(name)}", val)
+    for name, snap in sorted((stages.get("timers") or {}).items()):
+        base = f"tffm_timer_{_prom_name(name)}"
+        emit(f"{base}_count", snap.get("count", 0), "counter")
+        emit(f"{base}_seconds_total", snap.get("total_s", 0.0), "counter")
+        for pkey in ("mean_ms", "p50_ms", "p95_ms", "max_ms"):
+            if pkey in snap:
+                emit(f"{base}_{pkey}", snap[pkey])
+    for name, snap in sorted((stages.get("depths") or {}).items()):
+        if not snap.get("count"):
+            continue
+        base = f"tffm_depth_{_prom_name(name)}"
+        emit(f"{base}_events_total", snap["count"], "counter")
+        emit(f"{base}_mean", snap.get("mean", 0.0))
+        emit(f"{base}_max", snap.get("max", 0))
+        buckets = snap.get("buckets") or {}
+        if buckets:
+            lines.append(f"# TYPE {base}_bucket gauge")
+            for band, n in buckets.items():
+                lines.append(f'{base}_bucket{{band="{band}"}} {n}')
+    for block in ("health", "tiered"):
+        for key, val in sorted((record.get(block) or {}).items()):
+            emit(f"tffm_{block}_{_prom_name(key)}", val)
+    return "\n".join(lines) + "\n"
+
+
+class StatusServer:
+    """Serve ``/metrics`` + ``/status`` + ``/healthz`` for one run.
+
+    ``build`` returns the on-demand status record (the same callable
+    shape the Heartbeat takes; ``None`` degrades to an empty record so
+    the endpoint is up even before the owner has anything to report).
+    ``port=0`` binds an OS-assigned port (tests); the bound port is
+    ``self.port``.  ``host`` defaults to loopback — the endpoint is
+    unauthenticated, so publishing beyond the host (a real Prometheus
+    scrape) is an explicit opt-in (``status_host = 0.0.0.0``).
+    ``telemetry`` (optional) receives a ``status.requests`` counter so
+    scrape load shows up in snapshots.  ``close()`` shuts the server
+    down and joins its thread; idempotent.
+    """
+
+    def __init__(self, port: int, build: Callable[[], Optional[dict]],
+                 telemetry=None, host: str = "127.0.0.1"):
+        self._build = build
+        self._requests = (
+            telemetry.counter("status.requests")
+            if telemetry is not None else None
+        )
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # quiet access log
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                if server._requests is not None:
+                    server._requests.add()
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    self._send(200, b"ok\n", "text/plain")
+                    return
+                if path not in ("/metrics", "/status"):
+                    self._send(404, b"not found\n", "text/plain")
+                    return
+                try:
+                    record = server._build() or {}
+                except Exception as e:  # noqa: BLE001 - report, don't die
+                    self._send(
+                        500, f"builder failed: {e}\n".encode(),
+                        "text/plain",
+                    )
+                    return
+                if path == "/status":
+                    body = (json.dumps(record) + "\n").encode()
+                    self._send(200, body, "application/json")
+                else:
+                    body = render_prometheus(record).encode()
+                    self._send(
+                        200, body,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tffm-status",
+            daemon=True,
+        )
+        self._thread.start()
+        self._closed = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._thread.join()
+        self._httpd.server_close()

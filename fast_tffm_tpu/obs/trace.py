@@ -51,6 +51,7 @@ import contextlib
 import json
 import logging
 import os
+import queue
 import threading
 import time
 from typing import Optional
@@ -62,6 +63,10 @@ log = logging.getLogger(__name__)
 # Backstop against unbounded growth on very long runs: ~1M events is
 # ~250 MB of JSON — far beyond what Perfetto loads comfortably anyway.
 # Past the cap new events are dropped and counted (reported in dump()).
+# Runs that legitimately trace past it should ROTATE instead
+# (``rotate_events``): the buffer dumps and resets at the watermark,
+# producing trace.0.json, trace.1.json, ... that tools/report.py
+# --trace stitches back into one stream — no cap, no drops.
 _MAX_EVENTS = 1_000_000
 
 _NULL_CTX = contextlib.nullcontext()
@@ -109,12 +114,38 @@ class Tracer:
 
     def __init__(self, enabled: bool = True,
                  process_name: Optional[str] = None,
-                 max_events: int = _MAX_EVENTS):
+                 max_events: int = _MAX_EVENTS,
+                 rotate_events: int = 0,
+                 rotate_path: Optional[str] = None):
         self.enabled = enabled
         self._lock = threading.Lock()
         self._events: list = []
         self._dropped = 0
         self._max = max_events
+        # Windowed rotation (rotate_events > 0): when the buffer reaches
+        # the watermark, it is swapped out under the lock and handed to
+        # a dedicated background WRITER thread — the instrumented
+        # thread that crossed the watermark never pays the window's
+        # json-serialize+write (tens of MB at production watermarks; an
+        # inline dump would inject a periodic stall into whichever
+        # pipeline stage happened to cross).
+        # With rotation on, the drop cap does not apply at all: the
+        # buffer resets every window, so memory is bounded by the
+        # watermark (plus one in-flight shipment), and applying the
+        # cap anywhere near the watermark would drop events rotation
+        # exists to preserve (a worker-shipped batch crossing the cap
+        # used to truncate before the rotation check could run).
+        self._rotate_events = int(rotate_events or 0)
+        self._rotate_path = rotate_path
+        self._windows = 0
+        self._dropped_reported = 0
+        self._rotate_q: Optional[queue.Queue] = None
+        if self._rotate_events and enabled:
+            self._rotate_q = queue.Queue()
+            threading.Thread(
+                target=self._writer_loop, name="trace-rotate",
+                daemon=True,
+            ).start()
         self._pid = os.getpid()
         self._named_tids: set = set()
         self._process_name = process_name
@@ -199,10 +230,16 @@ class Tracer:
 
     def _append(self, ev: dict) -> None:
         with self._lock:
-            if len(self._events) >= self._max:
+            if not self._rotate_events and len(self._events) >= self._max:
                 self._dropped += 1
                 return
             self._events.append(ev)
+            rotate = (
+                self._rotate_events
+                and len(self._events) >= self._rotate_events
+            )
+        if rotate:
+            self._maybe_rotate()
 
     @property
     def dropped_events(self) -> int:
@@ -232,12 +269,92 @@ class Tracer:
         if not self.enabled or not events:
             return
         with self._lock:
-            room = self._max - len(self._events)
-            if room <= 0:
-                self._dropped += len(events)
-                return
-            self._events.extend(events[:room])
-            self._dropped += max(0, len(events) - room)
+            if self._rotate_events:
+                # No cap under rotation: a shipped batch must never
+                # truncate on its way into a window (zero-drop
+                # contract); the rotation below bounds memory.
+                self._events.extend(events)
+                rotate = len(self._events) >= self._rotate_events
+            else:
+                room = self._max - len(self._events)
+                if room <= 0:
+                    self._dropped += len(events)
+                    return
+                self._events.extend(events[:room])
+                self._dropped += max(0, len(events) - room)
+                rotate = False
+        if rotate:
+            self._maybe_rotate()
+
+    # ------------------------------------------------------------------
+    # windowed rotation
+    # ------------------------------------------------------------------
+
+    @property
+    def windows_written(self) -> int:
+        """Rotated window files dumped so far (excluding the final one
+        :meth:`dump` writes)."""
+        with self._lock:
+            return self._windows
+
+    def window_path(self, idx: int) -> str:
+        """``trace.json`` -> ``trace.<idx>.json`` (other extensions get
+        ``<path>.<idx>.json`` appended — rank-suffixed paths stay
+        greppable as one family)."""
+        base = self._rotate_path or "trace.json"
+        stem, ext = os.path.splitext(base)
+        if ext == ".json":
+            return f"{stem}.{idx}.json"
+        return f"{base}.{idx}.json"
+
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._rotate_q.get()
+            try:
+                if item is None:
+                    return
+                self._write_window(*item)
+            finally:
+                self._rotate_q.task_done()
+
+    def _maybe_rotate(self) -> None:
+        """Swap the full buffer out under the lock and enqueue it for
+        the writer thread.  Instrumented threads only ever pay the
+        swap; the file write happens off the hot path.  A losing racer
+        sees the already-reset buffer and returns."""
+        with self._lock:
+            if len(self._events) < self._rotate_events:
+                return  # lost the race; the buffer already rotated
+            events, self._events = self._events, []
+            idx = self._windows
+            self._windows += 1
+            dropped = self._dropped - self._dropped_reported
+            self._dropped_reported = self._dropped
+        self._rotate_q.put((idx, events, dropped))
+
+    def _write_window(self, idx: int, events: list,
+                      dropped: int) -> None:
+        """One window file.  All windows of a run share the clock
+        anchors (the run stays ONE timeline); ``window`` + the shared
+        anchors are how ``tools/report.py --trace`` re-joins a rotated
+        stream before chain reconstruction."""
+        path = self.window_path(idx)
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "wall_anchor": self._wall_anchor,
+                "perf_anchor": self._perf_anchor,
+                "pid": self._pid,
+                "window": idx,
+                "dropped_events": dropped,
+            },
+        }
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        except OSError as e:  # pragma: no cover - full volume
+            log.warning("trace window dump failed (%s): %s", path, e)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -247,9 +364,16 @@ class Tracer:
         """Drop buffered events and re-anchor (per-run accounting, like
         Telemetry.reset).  The process-name metadata survives — it names
         the lane, not the run."""
+        if self._rotate_q is not None:
+            # A previous run's windows must finish writing before the
+            # counters restart, or run-2's window 0 could interleave
+            # with run-1's tail.
+            self._rotate_q.join()
         with self._lock:
             self._events = []
             self._dropped = 0
+            self._dropped_reported = 0
+            self._windows = 0
             self._named_tids = set()
         self._wall_anchor = time.time()
         self._perf_anchor = time.perf_counter()
@@ -259,10 +383,27 @@ class Tracer:
     def dump(self, path: str) -> int:
         """Write the Perfetto-loadable JSON; returns the event count.
 
+        With rotation configured, ``path`` is ignored in favor of the
+        next window file — the run's ENTIRE output is the uniform
+        ``trace.0.json .. trace.N.json`` family (the final window holds
+        whatever was buffered past the last watermark crossing).
+
         ``otherData`` carries the wall/perf clock anchors so
         ``tools/report.py --trace`` can place traces from different
         hosts (multi-rank runs) on one wall-clock timeline.
         """
+        if self._rotate_events and self._rotate_q is not None:
+            with self._lock:
+                events, self._events = self._events, []
+                idx = self._windows
+                self._windows += 1
+                dropped = self._dropped - self._dropped_reported
+                self._dropped_reported = self._dropped
+            self._rotate_q.put((idx, events, dropped))
+            # End of run: every window must be on disk when dump
+            # returns (the caller logs the family and may exit).
+            self._rotate_q.join()
+            return len(events)
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
@@ -270,7 +411,8 @@ class Tracer:
             log.warning(
                 "trace buffer overflowed: %d event(s) dropped past the "
                 "%d-event cap — %s is TRUNCATED (chains stop mid-run); "
-                "trace shorter runs or raise max_events",
+                "trace shorter runs, raise max_events, or rotate "
+                "windows (trace_rotate_events)",
                 dropped, self._max, path,
             )
         doc = {

@@ -379,9 +379,21 @@ class Trainer:
         # Causal batch tracer (Chrome-trace spans; obs/trace.py).  Only
         # live when cfg.trace_file names an output — otherwise every
         # span call is a shared no-op, and training is bit-identical.
+        # One trace path per process: rank 0 owns the configured path,
+        # ranks > 0 suffix theirs (tools/report.py --trace merges the
+        # fleet).  With trace_rotate_events set, the tracer dumps and
+        # resets at the watermark (trace.0.json, trace.1.json, ...) so
+        # multi-hour traced runs never hit the in-memory event cap.
+        self._trace_path = cfg.trace_file
+        if cfg.trace_file and jax.process_index() > 0:
+            self._trace_path = (
+                f"{cfg.trace_file}.rank{jax.process_index()}"
+            )
         self.tracer = obs.Tracer(
             enabled=bool(cfg.trace_file),
             process_name=f"trainer rank{jax.process_index()}",
+            rotate_events=cfg.trace_rotate_events,
+            rotate_path=self._trace_path,
         )
         # Input-pipeline position for checkpointed mid-epoch resume.
         self._epoch = 0
@@ -1073,7 +1085,10 @@ class Trainer:
                 "telemetry": cfg.telemetry,
                 "heartbeat_secs": cfg.heartbeat_secs,
                 "trace_file": cfg.trace_file,
+                "trace_rotate_events": cfg.trace_rotate_events,
                 "nan_policy": cfg.nan_policy,
+                "status_port": cfg.status_port,
+                "alert_rules": cfg.alert_rules,
                 "jax_version": jax.__version__,
                 "backend": jax.default_backend(),
                 "mesh": {str(a): int(n) for a, n in self.mesh.shape.items()},
@@ -1105,17 +1120,26 @@ class Trainer:
         self._health_host = {}
         if self.tiered is not None:
             self.tiered.reopen()  # re-arm after a cancelled prior run
-        pending_health = None  # (nonfinite_arr, grad_sq_arr, stepno)
+        pending_health = None  # (nonfinite_arr, grad_sq_arr, grad_sq_sum_arr, stepno)
         nonfinite_warned = False
 
         def check_health(pending) -> None:
             """Consume one delayed health readback; apply nan_policy."""
             nonlocal nonfinite_warned
-            nf_arr, gs_arr, at_step = pending
+            nf_arr, gs_arr, ss_arr, at_step = pending
             nf = int(nf_arr)
             gs = float(gs_arr)
+            ss = float(ss_arr)
             self._health_host["grad_norm"] = round(
                 float(np.sqrt(gs)) if np.isfinite(gs) else gs, 6
+            )
+            # RMS from the same readback: heartbeat-path rules on the
+            # documented grad_norm_rms signal (and /status scrapes)
+            # must see it live, not only at log cadence — a halt rule
+            # on a signal that never materializes is silently inert.
+            rms = ss / max(1, at_step)
+            self._health_host["grad_norm_rms"] = round(
+                float(np.sqrt(rms)) if np.isfinite(rms) else rms, 6
             )
             self._health_host["nonfinite_steps"] = nf
             if nf <= 0:
@@ -1244,32 +1268,93 @@ class Trainer:
                 "dispatch_s": round(disp_s, 3),
                 "other_s": round(max(0.0, wall - wait_s - disp_s), 3),
                 "ingest_wait_frac": round(wait_s / wall, 4),
-                "truncated_features": int(pipeline.truncated_features),
-                "out_of_range_batches": int(pipeline.oor_batches),
-                "ingest_cache": pipeline.cache_result,
+                # Data-integrity counters (pipeline.stats): truncation,
+                # out-of-range batches, cache outcome.
+                **pipeline.stats(),
                 # Training-health monitors (scan-carry): host-cached
                 # scalars only on the heartbeat path; exact values are
                 # refreshed at log cadence and for the final record.
                 "health": self._health_summary(exact=(kind == "final")),
                 "stages": self.telemetry.snapshot(),
             }
+            if kind == "status" and stepno == 0:
+                # Same over-count the heartbeat path suppresses by
+                # skipping the beat (see the docstring): before the
+                # first dispatch the wait timer has only startup (jit
+                # compile, cache rebuild) to attribute against, and a
+                # scraped ingest_wait_frac ~= 1 would page someone for
+                # a startup artifact.  /status must still ANSWER, so
+                # the attribution keys are omitted (no Prometheus
+                # series yet, rather than a lying one) and the record
+                # says why.
+                for key in ("wait_input_s", "dispatch_s", "other_s",
+                            "ingest_wait_frac"):
+                    del rec[key]
+                rec["warming_up"] = True
             if self.tiered is not None:
                 # Hot/cold cache behavior (host-side counters only —
                 # safe from the heartbeat thread).
                 rec["tiered"] = self.tiered.snapshot()
-            if kind == "final" and self.tracer.enabled:
+            if self.tracer.enabled:
                 # Truncation truthfulness: a trace that hit the event
-                # cap silently lies by omission; the count rides the
-                # final record so report tooling can flag it.
+                # cap silently lies by omission; the count rides every
+                # self-report (heartbeat / status / final) so the alert
+                # watchdog and report tooling can flag it live.
                 rec["trace_dropped_events"] = self.tracer.dropped_events
+                if cfg.trace_rotate_events:
+                    rec["trace_windows"] = self.tracer.windows_written
+            return rec
+
+        # Alert watchdog: declarative rules evaluated against every
+        # heartbeat record ON the heartbeat thread (obs/alerts.py).
+        # Breaches emit `record: alert` JSONL entries; an action=halt
+        # rule arms engine.halted and the DISPATCH loop below raises
+        # AlertHaltError at the next boundary (same no-poisoned-
+        # checkpoint contract as nan_policy=halt).
+        alert_engine = None
+        if cfg.alert_rules:
+            # FmConfig already guarantees heartbeat_secs > 0 whenever
+            # rules are set (a watchdog with no heartbeat to ride
+            # would be silently inert).
+            alert_engine = obs.AlertEngine(
+                obs.parse_rules(cfg.alert_rules), writer=metrics_out
+            )
+
+        def heartbeat_build():
+            rec = telemetry_record("heartbeat")
+            if rec is not None and alert_engine is not None:
+                alert_engine.observe(rec)
             return rec
 
         heartbeat = None
         if cfg.heartbeat_secs > 0:
             heartbeat = obs.Heartbeat(
-                cfg.heartbeat_secs, partial(telemetry_record, "heartbeat"),
-                writer=metrics_out,
+                cfg.heartbeat_secs, heartbeat_build, writer=metrics_out,
             )
+        # Live status endpoint: /metrics (Prometheus) + /status (the
+        # heartbeat-shaped JSON record, on demand) from an in-process
+        # stdlib HTTP server.  Requests read the same thread-safe
+        # snapshots a heartbeat does; with status_port unset no server
+        # exists and training is bit-identical.  A taken port degrades
+        # to a warning — an observability convenience must never kill
+        # the run it observes.
+        status_server = None
+        if cfg.status_port:
+            try:
+                status_server = obs.StatusServer(
+                    cfg.status_port, partial(telemetry_record, "status"),
+                    telemetry=self.telemetry, host=cfg.status_host,
+                )
+                log.info(
+                    "status endpoint listening on %s:%d "
+                    "(/metrics, /status, /healthz)", cfg.status_host,
+                    status_server.port,
+                )
+            except OSError as e:
+                log.warning(
+                    "status endpoint failed to bind port %d: %s",
+                    cfg.status_port, e,
+                )
         run_exc: Optional[BaseException] = None
         total_trunc = 0
         try:
@@ -1349,14 +1434,33 @@ class Trainer:
                     # one dispatch of the poisoned one.
                     nf_arr = self._health.nonfinite_steps
                     gs_arr = self._health.grad_sq_last
+                    ss_arr = self._health.grad_sq_sum
                     try:
                         nf_arr.copy_to_host_async()
                         gs_arr.copy_to_host_async()
+                        ss_arr.copy_to_host_async()
                     except Exception:  # pragma: no cover - backend drift
                         pass
                     if pending_health is not None:
                         check_health(pending_health)
-                    pending_health = (nf_arr, gs_arr, stepno)
+                    pending_health = (nf_arr, gs_arr, ss_arr, stepno)
+                    # Alert halt: the watchdog armed the flag on the
+                    # heartbeat thread; raising HERE (between
+                    # dispatches) keeps the halt on the main thread —
+                    # no checkpoint overwrite, crash-truthful final
+                    # record, same path as nan_policy=halt.
+                    if (
+                        alert_engine is not None
+                        and alert_engine.halted is not None
+                    ):
+                        a = alert_engine.halted
+                        raise obs.AlertHaltError(
+                            f"alert rule {a['rule']} fired with "
+                            f"action=halt at step {a['step']}: "
+                            f"{a['signal']}={a['value']} {a['op']} "
+                            f"{a['threshold']} (sustained "
+                            f"{a['sustain']} heartbeat(s))"
+                        )
                     if profiling and stepno >= profile_stop_at:
                         jax.block_until_ready(self.state)
                         jax.profiler.stop_trace()
@@ -1467,6 +1571,8 @@ class Trainer:
             finally:
                 if heartbeat is not None:
                     heartbeat.close()
+                if status_server is not None:
+                    status_server.close()
                 if self.tiered is not None:
                     # Wake a transfer thread blocked on a write-back
                     # fill that will never come — prefetcher.close()
@@ -1509,16 +1615,29 @@ class Trainer:
                     log.warning("final record write failed: %s", e)
                 metrics_out.close()
             if self.tracer.enabled:
-                # One trace file per process: rank 0 writes the
+                # One trace path per process: rank 0 writes the
                 # configured path, ranks > 0 suffix theirs (the
-                # documented naming — config.py/cli.py), and
-                # tools/report.py --trace merges the fleet.
-                tpath = cfg.trace_file
-                if jax.process_index() > 0:
-                    tpath = f"{tpath}.rank{jax.process_index()}"
+                # documented naming — computed once in __init__), and
+                # tools/report.py --trace merges the fleet.  With
+                # rotation on, this final dump closes the last window
+                # of the trace.0.json .. trace.N.json family.
                 try:
-                    n_ev = self.tracer.dump(tpath)
-                    log.info("wrote %d trace events to %s", n_ev, tpath)
+                    n_ev = self.tracer.dump(self._trace_path)
+                    if cfg.trace_rotate_events:
+                        n_win = self.tracer.windows_written
+                        log.info(
+                            "wrote %d trace window(s) (%d events in "
+                            "the last) — %s .. %s; merge with "
+                            "tools/report.py --trace",
+                            n_win, n_ev,
+                            self.tracer.window_path(0),
+                            self.tracer.window_path(n_win - 1),
+                        )
+                    else:
+                        log.info(
+                            "wrote %d trace events to %s", n_ev,
+                            self._trace_path,
+                        )
                 except OSError as e:  # pragma: no cover - full volume
                     log.warning("trace dump failed: %s", e)
         train_metrics = _finalize_metrics(self.state.metrics, cfg.loss_type)

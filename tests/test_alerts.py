@@ -1,0 +1,434 @@
+"""Alert-rule watchdog (ISSUE 7 tentpole, layer 2).
+
+Pins:
+
+  * the ``alert_rules`` grammar (ops, ``for N`` sustain, actions,
+    loud parse errors — a typo'd rule must fail config construction,
+    never silently watch nothing);
+  * engine semantics on synthetic heartbeat streams: fire/hold,
+    consecutive-breach sustain with reset on recovery AND on
+    non-evaluable beats, one fire per breach episode, the derived
+    signals (``grad_norm_drift`` rolling baseline, ``beat_gap_s``
+    staleness, queue-empty fractions);
+  * the pinned ``record: alert`` JSONL schema;
+  * integration: a warn rule fires during a real heartbeat'd training
+    run and lands in the metrics stream where ``tools/report.py``
+    summarizes it and ``--compare`` regression-gates it (alerts_total
+    and per-rule keys, per-key ``--threshold`` overrides);
+  * a halt rule stops a real run via ``AlertHaltError`` raised from
+    the dispatch loop, with the crash-truthful final record naming it
+    and no checkpoint written.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.obs.alerts import (
+    AlertEngine, AlertHaltError, AlertRule, BASELINE_MIN, parse_rules,
+)
+from fast_tffm_tpu.train.loop import Trainer
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+)
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import report  # noqa: E402
+
+
+class TestParseRules:
+    def test_full_grammar(self):
+        rules = parse_rules(
+            "ingest_wait_frac > 0.5 for 3 : warn ;\n"
+            "tiered.hot_hit_frac < 0.9 : halt"
+        )
+        assert rules == [
+            AlertRule("ingest_wait_frac", ">", 0.5, 3, "warn"),
+            AlertRule("tiered.hot_hit_frac", "<", 0.9, 1, "halt"),
+        ]
+        assert rules[0].name == "ingest_wait_frac>0.5"
+
+    def test_empty_and_blank_rules_skipped(self):
+        assert parse_rules("") == []
+        assert parse_rules(" ; ; ") == []
+
+    @pytest.mark.parametrize("bad", [
+        "no_action > 1",
+        "x > 1 : explode",
+        "x >= 1 : warn",
+        "x > nan_ish_word : warn",
+        "x > 1 for zero : warn",
+        "x > 1 for 0 : warn",
+        "> 1 : warn",
+    ])
+    def test_grammar_errors_are_loud(self, bad):
+        with pytest.raises(ValueError, match="alert rule"):
+            parse_rules(bad)
+
+    def test_config_validates_rules_at_construction(self):
+        with pytest.raises(ValueError, match="alert rule"):
+            FmConfig(alert_rules="bogus rule")
+        FmConfig(
+            alert_rules="ingest_wait_frac > 0.5 : warn",
+            heartbeat_secs=30,
+        )  # ok
+
+
+def _rec(**kw) -> dict:
+    rec = {"record": "heartbeat", "step": kw.pop("step", 1)}
+    rec.update(kw)
+    return rec
+
+
+class TestEngineSemantics:
+    def test_fires_on_breach_and_holds_below(self):
+        eng = AlertEngine(parse_rules("ingest_wait_frac > 0.5 : warn"))
+        assert eng.observe(_rec(ingest_wait_frac=0.2)) == []
+        fired = eng.observe(_rec(ingest_wait_frac=0.8, step=4))
+        assert len(fired) == 1
+        a = fired[0]
+        # The pinned alert-record schema.
+        assert a == {
+            "record": "alert", "time": a["time"], "step": 4,
+            "rule": "ingest_wait_frac>0.5",
+            "signal": "ingest_wait_frac", "value": 0.8,
+            "threshold": 0.5, "op": ">", "sustain": 1,
+            "action": "warn",
+        }
+        assert eng.fired_total == 1 and eng.halted is None
+
+    def test_sustain_requires_consecutive_breaches(self):
+        eng = AlertEngine(
+            parse_rules("ingest_wait_frac > 0.5 for 3 : warn")
+        )
+        assert eng.observe(_rec(ingest_wait_frac=0.9)) == []
+        assert eng.observe(_rec(ingest_wait_frac=0.9)) == []
+        # Recovery resets the streak.
+        assert eng.observe(_rec(ingest_wait_frac=0.1)) == []
+        assert eng.observe(_rec(ingest_wait_frac=0.9)) == []
+        assert eng.observe(_rec(ingest_wait_frac=0.9)) == []
+        assert len(eng.observe(_rec(ingest_wait_frac=0.9))) == 1
+
+    def test_one_fire_per_breach_episode(self):
+        eng = AlertEngine(parse_rules("ingest_wait_frac > 0.5 : warn"))
+        assert len(eng.observe(_rec(ingest_wait_frac=0.9))) == 1
+        # Still breaching: no re-fire spam.
+        assert eng.observe(_rec(ingest_wait_frac=0.9)) == []
+        # Recover, breach again: a NEW episode fires.
+        assert eng.observe(_rec(ingest_wait_frac=0.1)) == []
+        assert len(eng.observe(_rec(ingest_wait_frac=0.9))) == 1
+        assert eng.fired_total == 2
+
+    def test_missing_signal_resets_streak(self):
+        eng = AlertEngine(
+            parse_rules("tiered.hot_hit_frac < 0.9 for 2 : warn")
+        )
+        assert eng.observe(_rec(tiered={"hot_hit_frac": 0.5})) == []
+        # A beat without the tiered block (e.g. tiering off) must not
+        # count toward the streak.
+        assert eng.observe(_rec()) == []
+        assert eng.observe(_rec(tiered={"hot_hit_frac": 0.5})) == []
+        fired = eng.observe(_rec(tiered={"hot_hit_frac": 0.5}))
+        assert len(fired) == 1
+
+    def test_less_than_op_and_aliases(self):
+        eng = AlertEngine(parse_rules(
+            "hot_hit_frac < 0.9 : warn ; nonfinite_steps > 0 : warn"
+        ))
+        fired = eng.observe(_rec(
+            tiered={"hot_hit_frac": 0.5},
+            health={"nonfinite_steps": 2},
+        ))
+        assert {a["signal"] for a in fired} == {
+            "hot_hit_frac", "nonfinite_steps"
+        }
+
+    def test_dotted_instrument_names_resolve(self):
+        eng = AlertEngine(parse_rules(
+            "stages.gauges.ingest.oor_batches > 0 : warn"
+        ))
+        fired = eng.observe(_rec(
+            stages={"gauges": {"ingest.oor_batches": 3}}
+        ))
+        assert len(fired) == 1 and fired[0]["value"] == 3.0
+
+    def test_escalation_pair_sharing_a_name_both_fire(self):
+        """Two rules may differ only in sustain/action (warn early,
+        halt if sustained) and therefore share AlertRule.name; state
+        keyed per RULE must let both fire independently — name-keyed
+        state used to let the warn rule swallow the halt forever."""
+        eng = AlertEngine(parse_rules(
+            "ingest_wait_frac > 0.5 : warn ; "
+            "ingest_wait_frac > 0.5 for 3 : halt"
+        ))
+        fired = eng.observe(_rec(ingest_wait_frac=0.9))
+        assert [a["action"] for a in fired] == ["warn"]
+        assert eng.observe(_rec(ingest_wait_frac=0.9)) == []
+        fired = eng.observe(_rec(ingest_wait_frac=0.9))
+        assert [a["action"] for a in fired] == ["halt"]
+        assert eng.halted is not None
+
+    def test_halt_arms_halted_flag(self):
+        eng = AlertEngine(parse_rules("step > 5 : halt"))
+        assert eng.observe(_rec(step=3)) == []
+        assert eng.halted is None
+        eng.observe(_rec(step=8))
+        assert eng.halted is not None
+        assert eng.halted["action"] == "halt"
+
+    def test_writer_receives_jsonl(self, tmp_path):
+        path = str(tmp_path / "alerts.jsonl")
+        writer = obs.JsonlWriter(path)
+        eng = AlertEngine(
+            parse_rules("ingest_wait_frac > 0.5 : warn"), writer=writer
+        )
+        eng.observe(_rec(ingest_wait_frac=0.9))
+        writer.close()
+        recs = [json.loads(l) for l in open(path)]
+        assert len(recs) == 1 and recs[0]["record"] == "alert"
+
+    def test_warn_logs(self, caplog):
+        eng = AlertEngine(parse_rules("ingest_wait_frac > 0.5 : warn"))
+        with caplog.at_level("WARNING", logger="fast_tffm_tpu.obs.alerts"):
+            eng.observe(_rec(ingest_wait_frac=0.9))
+        assert any("ALERT" in r.message for r in caplog.records)
+
+
+class TestDerivedSignals:
+    def test_grad_norm_drift_needs_baseline_then_fires(self):
+        eng = AlertEngine(parse_rules("grad_norm_drift > 5 : warn"))
+        # Stable grad norms build the baseline; none may fire (the
+        # baseline excludes the current beat, so drift stays ~1).
+        for i in range(BASELINE_MIN):
+            assert eng.observe(
+                _rec(health={"grad_norm": 1.0}, step=i)
+            ) == []
+        # A 10x spike against the rolling baseline fires.
+        fired = eng.observe(_rec(health={"grad_norm": 10.0}, step=99))
+        assert len(fired) == 1
+        assert fired[0]["value"] == pytest.approx(10.0)
+
+    def test_grad_norm_drift_not_evaluable_without_history(self):
+        eng = AlertEngine(parse_rules("grad_norm_drift > 0.0001 : warn"))
+        # Even a "fire on anything" drift rule holds until the
+        # baseline exists.
+        assert eng.observe(_rec(health={"grad_norm": 100.0})) == []
+
+    def test_beat_gap_staleness(self):
+        clock = {"t": 1000.0}
+        eng = AlertEngine(
+            parse_rules("beat_gap_s > 10 : warn"),
+            clock=lambda: clock["t"],
+        )
+        assert eng.observe(_rec()) == []  # no previous beat yet
+        clock["t"] += 5
+        assert eng.observe(_rec()) == []
+        clock["t"] += 60  # the loop stalled
+        fired = eng.observe(_rec())
+        assert len(fired) == 1 and fired[0]["value"] == 60.0
+
+    def test_queue_empty_frac(self):
+        eng = AlertEngine(
+            parse_rules("prefetch_out_empty_frac > 0.5 : warn")
+        )
+        busy = {"count": 10, "buckets": {"1": 10}}
+        starved = {"count": 10, "buckets": {"0": 8, "1": 2}}
+        assert eng.observe(_rec(
+            stages={"depths": {"prefetch.out_q_depth": busy}}
+        )) == []
+        fired = eng.observe(_rec(
+            stages={"depths": {"prefetch.out_q_depth": starved}}
+        ))
+        assert len(fired) == 1 and fired[0]["value"] == 0.8
+
+
+# ---------------------------------------------------------------------------
+# Integration: rules riding a real run's heartbeat
+# ---------------------------------------------------------------------------
+
+
+def _write_libsvm(path, n_lines, vocab=50, n_feat=3, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            feats = rng.choice(vocab, size=n_feat, replace=False)
+            toks = " ".join(f"{i}:{rng.uniform(0.1, 1):.3f}" for i in feats)
+            f.write(f"{rng.integers(0, 2)} {toks}\n")
+    return str(path)
+
+
+def _cfg(data, tmp_path, tag, **kw):
+    defaults = dict(
+        vocabulary_size=50,
+        factor_num=4,
+        model_file=str(tmp_path / f"model_{tag}"),
+        train_files=[data],
+        epoch_num=1,
+        batch_size=32,
+        max_features=4,
+        log_steps=0,
+        thread_num=2,
+        steps_per_dispatch=4,
+        seed=3,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+@pytest.fixture(scope="module")
+def train_file(tmp_path_factory):
+    out = tmp_path_factory.mktemp("alert_data")
+    return _write_libsvm(out / "train.libsvm", 640)
+
+
+def _throttle(trainer, delay_s: float):
+    """Slow each dispatch so heartbeats (and the rules riding them)
+    get a deterministic number of chances to fire mid-run."""
+    real = trainer._scan_train_step
+
+    def slow(state, batches):
+        time.sleep(delay_s)
+        return real(state, batches)
+
+    trainer._scan_train_step = slow
+
+
+class TestAlertIntegration:
+    def test_warn_rule_fires_into_metrics_stream(self, train_file,
+                                                 tmp_path, capsys):
+        mf = str(tmp_path / "warn.jsonl")
+        cfg = _cfg(
+            train_file, tmp_path, "warnrule",
+            heartbeat_secs=0.05, metrics_file=mf,
+            # step is always >= 4 at the first post-dispatch beat.
+            alert_rules="step > 0 : warn",
+        )
+        trainer = Trainer(cfg)
+        _throttle(trainer, 0.05)
+        result = trainer.train()  # must complete under warn
+        assert result["train"]["steps"] == 20
+        recs = [json.loads(l) for l in open(mf)]
+        alerts = [r for r in recs if r.get("record") == "alert"]
+        assert len(alerts) == 1  # one breach episode, one record
+        assert alerts[0]["rule"] == "step>0"
+        assert alerts[0]["action"] == "warn"
+        # The run header names the rule set (stream self-description).
+        header = [r for r in recs if r.get("record") == "run_header"][0]
+        assert header["alert_rules"] == "step > 0 : warn"
+        # The documented rule signals are LIVE on the heartbeat path:
+        # grad_norm_rms rides the same delayed readback as grad_norm
+        # (a rule on it must not be silently inert at log_steps=0).
+        hb = [r for r in recs if r.get("record") == "heartbeat"][-1]
+        assert "grad_norm_rms" in hb["health"]
+        assert "grad_norm" in hb["health"]
+        # report.py surfaces the alert section.
+        assert report.main([mf]) == 0
+        out = capsys.readouterr().out
+        assert "alerts (1 fired)" in out
+        assert "step>0" in out
+
+    def test_halt_rule_stops_run_without_checkpoint(self, train_file,
+                                                    tmp_path):
+        from fast_tffm_tpu.train import checkpoint
+
+        mf = str(tmp_path / "halt.jsonl")
+        cfg = _cfg(
+            train_file, tmp_path, "haltrule",
+            heartbeat_secs=0.05, metrics_file=mf,
+            alert_rules="step > 0 : halt",
+        )
+        trainer = Trainer(cfg)
+        _throttle(trainer, 0.05)
+        with pytest.raises(AlertHaltError, match="step>0"):
+            trainer.train()
+        # Halted mid-run: nothing like the full 20 steps trained, and
+        # no checkpoint was written on the way down.
+        assert int(trainer.state.step) < 20
+        assert not checkpoint.exists(cfg.model_file)
+        recs = [json.loads(l) for l in open(mf)]
+        final = [r for r in recs if r.get("record") == "final"][-1]
+        assert final["exception"] == "AlertHaltError"
+        assert any(r.get("record") == "alert" and r["action"] == "halt"
+                   for r in recs)
+
+    def test_compare_gates_alerting_run(self, train_file, tmp_path,
+                                        capsys):
+        """A clean run vs the same run alerting: alerts_total (present
+        as 0 on the clean side) flags as a regression."""
+        clean = str(tmp_path / "clean.jsonl")
+        cfg = _cfg(
+            train_file, tmp_path, "clean",
+            heartbeat_secs=0.05, metrics_file=clean,
+        )
+        t = Trainer(cfg)
+        _throttle(t, 0.05)
+        t.train()
+        alerting = str(tmp_path / "alerting.jsonl")
+        cfg2 = _cfg(
+            train_file, tmp_path, "alerting",
+            heartbeat_secs=0.05, metrics_file=alerting,
+            alert_rules="step > 0 : warn",
+        )
+        t2 = Trainer(cfg2)
+        _throttle(t2, 0.05)
+        t2.train()
+        rc = report.main(["--compare", clean, alerting])
+        out = capsys.readouterr().out
+        assert rc == 2
+        assert "alerts_total" in out
+        # Per-key threshold overrides share the same vocabulary: an
+        # absurdly loose override on alerts_total (inf never exceeds a
+        # ratio check... use the elapsed key instead) — here, verify a
+        # per-key override changes the verdict for a real key.
+        rc2 = report.main([
+            "--compare", clean, clean,
+            "--threshold", "default=0.05",
+        ])
+        assert rc2 == 0
+
+    def test_rules_without_heartbeat_fail_at_startup(self):
+        """Rules with no heartbeat to ride would never evaluate — for
+        a halt rule that is a silently inert safety mechanism, so the
+        config refuses it at construction."""
+        with pytest.raises(ValueError, match="heartbeat_secs"):
+            FmConfig(alert_rules="step > 0 : halt")  # heartbeat off
+
+
+class TestThresholdOverrides:
+    def test_parse_thresholds_forms(self):
+        assert report.parse_thresholds(None) == {"default": 0.05}
+        assert report.parse_thresholds(["0.07"]) == {"default": 0.07}
+        assert report.parse_thresholds(
+            ["ingest_wait_frac=0.10", "default=0.02"]
+        ) == {"default": 0.02, "ingest_wait_frac": 0.10}
+        with pytest.raises(SystemExit):
+            report.parse_thresholds(["ingest_wait_frac=abc"])
+
+    def test_per_key_override_changes_verdict(self, tmp_path, capsys):
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        a.write_text(json.dumps(
+            {"metric": "x", "value": 100.0, "ingest_wait_frac": 0.10}
+        ))
+        b.write_text(json.dumps(
+            {"metric": "x", "value": 100.0, "ingest_wait_frac": 0.108}
+        ))
+        # 8% worse wait: flagged at the default 5%...
+        assert report.main(["--compare", str(a), str(b)]) == 2
+        capsys.readouterr()
+        # ...but passes with a 10% per-key override while the default
+        # stays tight for everything else.
+        rc = report.main([
+            "--compare", str(a), str(b),
+            "--threshold", "ingest_wait_frac=0.10",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "per-key override" in out

@@ -1,0 +1,431 @@
+"""Live status endpoint (ISSUE 7 tentpole, layer 1) + the obs
+metric-name drift lint.
+
+Pins the endpoint guarantees:
+
+  * ``render_prometheus`` emits valid Prometheus text exposition for
+    every instrument class (counter / gauge / timer / depth histogram)
+    plus the health/tiered blocks and record scalars;
+  * ``StatusServer`` serves ``/metrics`` + ``/status`` + ``/healthz``
+    from its own threads, degrades builder failures to 500 (never
+    dies), observes its own scrape load, and closes cleanly;
+  * wired through ``status_port``, the endpoint answers DURING a real
+    training run with Prometheus-parseable text and the heartbeat-
+    shaped JSON record — and the server is gone once train() returns;
+  * ``status_port`` unset -> no server exists and training is
+    bit-identical to a run with the endpoint up (read-only contract);
+  * tools/check_obs.py keeps the code's instrument registry and the
+    OBSERVABILITY.md schema table in lockstep.
+"""
+
+import json
+import os
+import re
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.train.loop import Trainer
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+)
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import check_obs  # noqa: E402
+from obs_smoke import check_prometheus  # noqa: E402
+
+
+def _get(port: int, route: str, timeout: float = 5.0) -> tuple:
+    """(http status, body bytes) for one local GET."""
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=timeout
+        )
+        return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+_RECORD = {
+    "record": "status",
+    "step": 12,
+    "elapsed": 3.25,
+    "ingest_wait_frac": 0.02,
+    "ingest_cache": "off",  # non-numeric scalar: must be skipped
+    "stages": {
+        "counters": {"ingest.examples": 4096, "ingest.batches": 4},
+        "gauges": {"ingest.oor_batches": 0},
+        "timers": {
+            "train.dispatch": {
+                "count": 3, "total_s": 0.5, "mean_ms": 166.7,
+                "p50_ms": 160.0, "p95_ms": 180.0, "max_ms": 181.0,
+            },
+            "never.fired": {"count": 0, "total_s": 0.0},
+        },
+        "depths": {
+            "ingest.out_q_depth": {
+                "count": 10, "mean": 1.5, "max": 4,
+                "buckets": {"0": 4, "1": 6},
+            },
+            "empty.hist": {"count": 0},
+        },
+    },
+    "health": {"grad_norm": 0.5, "nonfinite_steps": 0},
+    "tiered": {"hot_hit_frac": 0.99, "resident_rows": 128},
+}
+
+
+class TestRenderPrometheus:
+    def test_output_is_prometheus_parseable(self):
+        text = obs.render_prometheus(_RECORD)
+        assert check_prometheus(text) > 0
+
+    def test_every_instrument_class_represented(self):
+        text = obs.render_prometheus(_RECORD)
+        for series in (
+            "tffm_step 12",
+            "tffm_ingest_wait_frac 0.02",
+            "tffm_counter_ingest_examples_total 4096",
+            "tffm_gauge_ingest_oor_batches 0",
+            "tffm_timer_train_dispatch_count 3",
+            "tffm_timer_train_dispatch_seconds_total 0.5",
+            "tffm_timer_train_dispatch_p95_ms 180.0",
+            'tffm_depth_ingest_out_q_depth_bucket{band="0"} 4',
+            "tffm_health_grad_norm 0.5",
+            "tffm_tiered_hot_hit_frac 0.99",
+        ):
+            assert series in text, series
+
+    def test_type_lines_and_sanitized_names(self):
+        text = obs.render_prometheus(_RECORD)
+        assert "# TYPE tffm_counter_ingest_examples_total counter" in text
+        assert "# TYPE tffm_step gauge" in text
+        # Dots sanitize to underscores; no dotted name leaks through.
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                name = line.split("{")[0].split(" ")[0]
+                assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), \
+                    line
+
+    def test_non_numeric_scalars_skipped(self):
+        text = obs.render_prometheus(_RECORD)
+        assert "ingest_cache" not in text
+        assert "tffm_record" not in text
+
+    def test_empty_record_renders_empty_but_valid(self):
+        text = obs.render_prometheus({})
+        assert text == "\n"
+
+
+class TestStatusServer:
+    def test_serves_status_metrics_healthz(self):
+        server = obs.StatusServer(0, lambda: dict(_RECORD))
+        try:
+            code, body = _get(server.port, "/status")
+            assert code == 200
+            rec = json.loads(body)
+            assert rec["record"] == "status" and rec["step"] == 12
+            code, body = _get(server.port, "/metrics")
+            assert code == 200
+            assert check_prometheus(body.decode()) > 0
+            code, body = _get(server.port, "/healthz")
+            assert code == 200 and body == b"ok\n"
+        finally:
+            server.close()
+
+    def test_unknown_route_404(self):
+        server = obs.StatusServer(0, lambda: {})
+        try:
+            code, _ = _get(server.port, "/nope")
+            assert code == 404
+        finally:
+            server.close()
+
+    def test_none_record_serves_empty(self):
+        """Before the owner has anything to report, the endpoint is up
+        and well-formed rather than erroring."""
+        server = obs.StatusServer(0, lambda: None)
+        try:
+            code, body = _get(server.port, "/status")
+            assert code == 200 and json.loads(body) == {}
+            code, _ = _get(server.port, "/metrics")
+            assert code == 200
+        finally:
+            server.close()
+
+    def test_builder_exception_degrades_to_500(self):
+        def bad():
+            raise RuntimeError("torn down")
+
+        server = obs.StatusServer(0, bad)
+        try:
+            code, body = _get(server.port, "/status")
+            assert code == 500 and b"torn down" in body
+        finally:
+            server.close()
+
+    def test_scrape_load_is_observable(self):
+        tel = obs.Telemetry(enabled=True)
+        server = obs.StatusServer(0, lambda: {}, telemetry=tel)
+        try:
+            for _ in range(3):
+                _get(server.port, "/metrics")
+            assert tel.counter("status.requests").value == 3
+        finally:
+            server.close()
+
+    def test_close_is_idempotent_and_frees_port(self):
+        server = obs.StatusServer(0, lambda: {})
+        port = server.port
+        server.close()
+        server.close()
+        with pytest.raises((urllib.error.URLError, OSError)):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=1
+            )
+
+
+# ---------------------------------------------------------------------------
+# Endpoint under concurrent training
+# ---------------------------------------------------------------------------
+
+
+def _write_libsvm(path, n_lines, vocab=50, n_feat=3, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            feats = rng.choice(vocab, size=n_feat, replace=False)
+            toks = " ".join(f"{i}:{rng.uniform(0.1, 1):.3f}" for i in feats)
+            f.write(f"{rng.integers(0, 2)} {toks}\n")
+    return str(path)
+
+
+def _cfg(data, tmp_path, tag, **kw):
+    defaults = dict(
+        vocabulary_size=50,
+        factor_num=4,
+        model_file=str(tmp_path / f"model_{tag}"),
+        train_files=[data],
+        epoch_num=1,
+        batch_size=32,
+        max_features=4,
+        log_steps=0,
+        thread_num=2,
+        steps_per_dispatch=4,
+        seed=3,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+@pytest.fixture(scope="module")
+def train_file(tmp_path_factory):
+    out = tmp_path_factory.mktemp("status_data")
+    return _write_libsvm(out / "train.libsvm", 640)
+
+
+def _throttle(trainer, delay_s: float):
+    """Slow each dispatch so the endpoint has a guaranteed mid-run
+    window to answer in (CPU runs of this size finish in well under a
+    second otherwise)."""
+    real = trainer._scan_train_step
+
+    def slow(state, batches):
+        time.sleep(delay_s)
+        return real(state, batches)
+
+    trainer._scan_train_step = slow
+
+
+class TestEndpointDuringTraining:
+    def test_serves_metrics_and_status_mid_run(self, train_file,
+                                               tmp_path):
+        port = _free_port()
+        cfg = _cfg(train_file, tmp_path, "live", status_port=port)
+        trainer = Trainer(cfg)
+        _throttle(trainer, 0.05)
+        got: dict = {}
+
+        def poll():
+            deadline = time.time() + 60
+            while time.time() < deadline and "metrics" not in got:
+                try:
+                    code, sbody = _get(port, "/status", timeout=1)
+                    if code != 200:
+                        continue
+                    code, mbody = _get(port, "/metrics", timeout=1)
+                    if code != 200:
+                        continue
+                    got["status"] = sbody
+                    got["metrics"] = mbody
+                except Exception:
+                    time.sleep(0.02)
+
+        poller = threading.Thread(target=poll)
+        poller.start()
+        trainer.train()
+        poller.join()
+        assert "metrics" in got, "endpoint never answered mid-run"
+        rec = json.loads(got["status"])
+        assert rec["record"] == "status"
+        # The heartbeat-record shape, on demand.
+        for key in ("step", "elapsed", "health", "stages",
+                    "truncated_features"):
+            assert key in rec, key
+        # Wall-clock attribution only once there is a dispatch to
+        # attribute against — a pre-first-dispatch scrape says
+        # warming_up instead of reporting startup as starvation.
+        if rec["step"] == 0:
+            assert rec.get("warming_up") is True
+            assert "ingest_wait_frac" not in rec
+        else:
+            assert "ingest_wait_frac" in rec
+        text = got["metrics"].decode()
+        assert check_prometheus(text) > 0
+        assert "tffm_counter_ingest_examples_total" in text
+        assert "tffm_timer_train_dispatch_count" in text
+        # The server died with the run.
+        with pytest.raises((urllib.error.URLError, OSError)):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=1
+            )
+
+    def test_taken_port_warns_and_trains_anyway(self, train_file,
+                                                tmp_path, caplog):
+        blocker = socket.socket()
+        blocker.bind(("0.0.0.0", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        try:
+            cfg = _cfg(train_file, tmp_path, "taken", status_port=port)
+            with caplog.at_level(
+                "WARNING", logger="fast_tffm_tpu.train.loop"
+            ):
+                result = Trainer(cfg).train()
+            assert result["train"]["steps"] == 20
+            assert any(
+                "status endpoint failed to bind" in r.message
+                for r in caplog.records
+            )
+        finally:
+            blocker.close()
+
+    def test_endpoint_off_is_bit_identical_to_on(self, train_file,
+                                                 tmp_path):
+        """The endpoint is read-only: training with it up (and being
+        scraped) produces bitwise-identical state to status_port=0."""
+        import jax
+
+        states = {}
+        for tag, port in (("on", _free_port()), ("off", 0)):
+            cfg = _cfg(
+                train_file, tmp_path, f"bit_{tag}", status_port=port
+            )
+            t = Trainer(cfg)
+            stop = threading.Event()
+            scraper = None
+            if port:
+                def scrape():
+                    while not stop.wait(0.01):
+                        try:
+                            _get(port, "/metrics", timeout=1)
+                        except Exception:
+                            pass
+
+                scraper = threading.Thread(target=scrape, daemon=True)
+                scraper.start()
+            t.train()
+            if scraper is not None:
+                stop.set()
+                scraper.join()
+            states[tag] = t.state
+        eq = jax.tree.map(
+            lambda a, b: bool(np.array_equal(np.asarray(a),
+                                             np.asarray(b))),
+            states["on"], states["off"],
+        )
+        assert all(jax.tree.leaves(eq))
+
+
+# ---------------------------------------------------------------------------
+# tools/check_obs.py — the metric-name drift lint verify.sh runs
+# ---------------------------------------------------------------------------
+
+
+class TestCheckObs:
+    def test_real_repo_passes(self):
+        repo = os.path.dirname(_TOOLS)
+        result = check_obs.audit(
+            os.path.join(repo, "fast_tffm_tpu"),
+            os.path.join(repo, "OBSERVABILITY.md"),
+        )
+        assert result["ok"], (
+            result["undocumented"], result["stale"],
+        )
+        # The live plane's own instrument is part of the contract.
+        assert "status.requests" in result["registered"]
+
+    def _fixture(self, tmp_path, code: str, rows: list) -> dict:
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(code)
+        md = tmp_path / "OBS.md"
+        table = "\n".join(
+            f"| `{name}` | counter | x | y |" for name in rows
+        )
+        md.write_text(
+            "# X\n\n## Metric schema\n\n| metric | kind | stage | "
+            "meaning |\n|---|---|---|---|\n" + table + "\n"
+        )
+        return check_obs.audit(str(pkg), str(md))
+
+    def test_undocumented_registration_fails(self, tmp_path):
+        result = self._fixture(
+            tmp_path,
+            'tel.counter("a.b")\ntel.timer("c.d")\n', ["a.b"],
+        )
+        assert not result["ok"]
+        assert result["undocumented"] == ["c.d"]
+        assert result["stale"] == []
+
+    def test_stale_table_row_fails(self, tmp_path):
+        result = self._fixture(
+            tmp_path, 'tel.counter("a.b")\n', ["a.b", "ghost.metric"],
+        )
+        assert not result["ok"]
+        assert result["stale"] == ["ghost.metric"]
+
+    def test_agreement_passes_and_empty_names_ignored(self, tmp_path):
+        result = self._fixture(
+            tmp_path,
+            'tel.counter("a.b")\nobs.NULL.counter("")\n'
+            'tel.depth_hist("q.d")\n',
+            ["a.b", "q.d"],
+        )
+        assert result["ok"], (result["undocumented"], result["stale"])
+
+    def test_missing_schema_table_fails(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text("x = 1\n")
+        md = tmp_path / "OBS.md"
+        md.write_text("# no table here\n")
+        assert not check_obs.audit(str(pkg), str(md))["ok"]

@@ -390,6 +390,231 @@ class TestTraceOff:
 
 
 # ---------------------------------------------------------------------------
+# Windowed trace rotation (ISSUE 7 tentpole, layer 3)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceRotation:
+    def test_rotates_at_watermark_with_zero_drops(self, tmp_path):
+        base = str(tmp_path / "t.json")
+        tr = obs.Tracer(enabled=True, rotate_events=5, rotate_path=base)
+        for i in range(17):
+            tr.point(f"e{i}")
+        # 17 events at watermark 5 -> 3 full windows already on disk.
+        assert tr.windows_written == 3
+        last = tr.dump(base)  # final window: the 2-event remainder
+        assert last == 2
+        assert tr.windows_written == 4
+        assert tr.dropped_events == 0
+        total = 0
+        for i in range(4):
+            doc = json.load(open(str(tmp_path / f"t.{i}.json")))
+            other = doc["otherData"]
+            assert other["window"] == i
+            assert other["dropped_events"] == 0
+            for key in ("wall_anchor", "perf_anchor", "pid"):
+                assert key in other
+            total += len(doc["traceEvents"])
+        assert total == 17  # every event landed in exactly one window
+
+    def test_watermark_above_event_cap_still_rotates(self, tmp_path):
+        """The in-memory drop cap must not apply under rotation: a
+        watermark past the cap used to hit the cap's drop path first
+        and silently never rotate — the exact truncation rotation
+        exists to prevent."""
+        base = str(tmp_path / "t.json")
+        tr = obs.Tracer(
+            enabled=True, max_events=10, rotate_events=20,
+            rotate_path=base,
+        )
+        for i in range(50):
+            tr.point(f"e{i}")
+        tr.dump(base)
+        assert tr.dropped_events == 0
+        total = sum(
+            len(json.load(open(str(p)))["traceEvents"])
+            for p in tmp_path.glob("t.*.json")
+        )
+        assert total == 50
+
+    def test_worker_shipment_crossing_watermark_never_truncates(
+        self, tmp_path
+    ):
+        """add_raw ships worker span BATCHES; a batch landing near the
+        watermark must rotate, not truncate (the cap's room check used
+        to drop the batch's tail before the rotation check ran)."""
+        base = str(tmp_path / "t.json")
+        tr = obs.Tracer(
+            enabled=True, max_events=20, rotate_events=20,
+            rotate_path=base,
+        )
+        for i in range(15):
+            tr.point(f"e{i}")
+        worker = obs.Tracer(enabled=True)
+        for i in range(30):
+            worker.point(f"w{i}")
+        tr.add_raw(worker.take())  # 15 + 30 crosses the watermark
+        tr.dump(base)
+        assert tr.dropped_events == 0
+        total = sum(
+            len(json.load(open(str(p)))["traceEvents"])
+            for p in tmp_path.glob("t.*.json")
+        )
+        assert total == 45
+
+    def test_window_naming(self, tmp_path):
+        tr = obs.Tracer(
+            enabled=True, rotate_events=5,
+            rotate_path=str(tmp_path / "trace.json"),
+        )
+        assert tr.window_path(0).endswith("trace.0.json")
+        tr2 = obs.Tracer(
+            enabled=True, rotate_events=5,
+            rotate_path=str(tmp_path / "trace.json.rank1"),
+        )
+        assert tr2.window_path(2).endswith("trace.json.rank1.2.json")
+
+    def test_reset_restarts_window_numbering(self, tmp_path):
+        base = str(tmp_path / "t.json")
+        tr = obs.Tracer(enabled=True, rotate_events=3, rotate_path=base)
+        for i in range(7):
+            tr.point(f"e{i}")
+        assert tr.windows_written == 2
+        tr.reset()
+        assert tr.windows_written == 0
+
+    def test_traced_run_rotates_and_chains_remerge(self, train_file,
+                                                   tmp_path, capsys):
+        """The acceptance criterion: a run traced past the watermark
+        yields rotated files that --trace merges back into COMPLETE
+        chains with zero dropped events — including chains that span a
+        rotation boundary."""
+        trace = str(tmp_path / "rot.json")
+        metrics = str(tmp_path / "rot_metrics.jsonl")
+        cfg = _cfg(
+            train_file, tmp_path, "rotate", trace_file=trace,
+            trace_rotate_events=40, metrics_file=metrics,
+        )
+        Trainer(cfg).train()
+        windows = sorted(
+            str(p) for p in tmp_path.glob("rot.*.json")
+        )
+        assert len(windows) >= 3, windows  # genuinely rotated
+        assert not (tmp_path / "rot.json").exists()  # windows only
+        # Zero drops, surfaced in the final record (rotation is WHY).
+        recs = [json.loads(l) for l in open(metrics)]
+        final = [r for r in recs if r.get("record") == "final"][-1]
+        assert final["trace_dropped_events"] == 0
+        assert final["trace_windows"] == len(windows) - 1  # pre-final
+        # Windows re-join into one stream; every chain reconnects.
+        merged = str(tmp_path / "rot_merged.json")
+        rc = report.main(["--trace"] + windows + ["-o", merged])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert (
+            f"re-joined {len(windows)} file(s) into 1 stream(s)" in out
+        )
+        # 640 lines / 32 = 20 batches at K=4 -> 5 dispatches.
+        assert "5 dispatched, 5 with a complete" in out
+        # And the merged artifact stays Perfetto-loadable.
+        doc = json.load(open(merged))
+        assert doc["traceEvents"]
+
+    def test_rotation_bitwise_identical_to_unrotated(self, train_file,
+                                                     tmp_path):
+        """Rotation is a storage policy of the trace output: the
+        recorded EVENTS (ignoring timestamps/ids) and the trained model
+        must match an unrotated run exactly."""
+        import jax
+
+        states = {}
+        for tag, rot in (("rot", 40), ("flat", 0)):
+            cfg = _cfg(
+                train_file, tmp_path, f"parity_{tag}",
+                trace_file=str(tmp_path / f"parity_{tag}.json"),
+                trace_rotate_events=rot,
+            )
+            t = Trainer(cfg)
+            t.train()
+            states[tag] = t.state
+        eq = jax.tree.map(
+            lambda a, b: bool(np.array_equal(np.asarray(a),
+                                             np.asarray(b))),
+            states["rot"], states["flat"],
+        )
+        assert all(jax.tree.leaves(eq))
+        flat_events = _events(str(tmp_path / "parity_flat.json"))
+        rot_events = []
+        for p in sorted(tmp_path.glob("parity_rot.*.json"),
+                        key=lambda p: int(p.name.split(".")[1])):
+            rot_events.extend(json.load(open(str(p)))["traceEvents"])
+
+        def stage_counts(events):
+            # Only the work-deterministic spans: thread-scheduling
+            # artifacts (thread_name metadata, conditional
+            # staging_wait spans) legitimately vary run to run.
+            out: dict = {}
+            for e in events:
+                if e.get("ph") == "X" and e["name"] in (
+                    "read.item", "parse.batch", "ingest.deliver",
+                    "prefetch.stack", "prefetch.h2d", "train.dispatch",
+                ):
+                    out[e["name"]] = out.get(e["name"], 0) + 1
+            return out
+
+        assert stage_counts(rot_events) == stage_counts(flat_events)
+        assert stage_counts(rot_events)["train.dispatch"] == 5
+
+    def test_straggler_section_names_slowest_rank(self, train_file,
+                                                  tmp_path, capsys):
+        """Two rank streams -> the merge grows a straggler section
+        attributing each chain segment to its slowest rank."""
+        trace = str(tmp_path / "strag.json")
+        cfg = _cfg(
+            train_file, tmp_path, "strag", trace_file=trace,
+            trace_rotate_events=40,
+        )
+        Trainer(cfg).train()
+        windows = sorted(str(p) for p in tmp_path.glob("strag.*.json"))
+        # Synthesize rank 1: same windows under a different pid +
+        # anchors (a different process would differ in exactly these).
+        rank1 = []
+        for i, path in enumerate(windows):
+            doc = json.load(open(path))
+            doc["otherData"]["pid"] = 99999
+            doc["otherData"]["wall_anchor"] += 1000.0
+            out = str(tmp_path / f"strag_rank1.{i}.json")
+            json.dump(doc, open(out, "w"))
+            rank1.append(out)
+        rc = report.main(
+            ["--trace"] + windows + rank1
+            + ["-o", str(tmp_path / "strag_merged.json")]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "10 dispatched, 10 with a complete" in out  # 5 + 5
+        assert "straggler attribution" in out
+        assert "slowest dispatch" in out
+        assert "slowest latency" in out
+
+    def test_unrotated_files_stay_separate_streams(self, traced_procs_run,
+                                                   tmp_path):
+        """Legacy traces (no window metadata) must keep the one-file =
+        one-rank contract even when byte-identical copies are merged
+        (sb ids restart per rank; anchor-grouping them would
+        cross-wire the chains)."""
+        import shutil
+
+        r0 = str(tmp_path / "a.json")
+        r1 = str(tmp_path / "b.json")
+        shutil.copy(traced_procs_run["trace"], r0)
+        shutil.copy(traced_procs_run["trace"], r1)
+        _, _, per_file = report.merge_traces([r0, r1])
+        streams = report.group_streams(per_file)
+        assert len(streams) == 2
+
+
+# ---------------------------------------------------------------------------
 # Health monitors: NaN injection under both nan_policy modes
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Metric-name drift lint: the code's instrument registry and the
+OBSERVABILITY.md schema table must agree EXACTLY.
+
+The failure mode this guards is silent on both sides: an instrument
+registered in code but missing from the schema table is invisible to
+anyone reading the docs (and to alert rules written from them); a
+documented metric that no code registers is a rule or dashboard
+watching a value that will never move.  Both get worse now that the
+names are a LIVE surface — Prometheus series names on ``/metrics`` and
+alert-rule signals resolve from exactly these strings.
+
+Mechanics (static, stdlib-only, milliseconds — same discipline as
+tools/check_tier1.py):
+
+- AST-walk every ``fast_tffm_tpu/**/*.py`` for
+  ``<anything>.counter("name") / .gauge(...) / .timer(...) /
+  .depth_hist(...) / .sample(...)`` calls whose first argument is a
+  non-empty string literal — the registry's create-or-return idiom
+  makes every registration look like this;
+- parse the ``## Metric schema`` table in OBSERVABILITY.md (first
+  backticked cell of each row is the metric name);
+- fail (exit 1) listing every name on one side only.
+
+Run directly, or via ``tools/verify.sh`` (wired into the audit step).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+_METHODS = {"counter", "gauge", "timer", "depth_hist", "sample"}
+_SCHEMA_HEADER = "## Metric schema"
+_ROW_NAME = re.compile(r"^\|\s*`([^`]+)`\s*\|")
+
+
+def registered_names(pkg_dir: str) -> dict:
+    """{name: [file:line, ...]} of every instrument registered in code."""
+    out: dict = {}
+    for root, _, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:
+                    continue  # other tooling flags unparsable sources
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value
+                ):
+                    continue
+                name = node.args[0].value
+                rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+                out.setdefault(name, []).append(f"{rel}:{node.lineno}")
+    return out
+
+
+def documented_names(md_path: str) -> set:
+    """Metric names from the ``## Metric schema`` table (first
+    backticked cell per row)."""
+    out: set = set()
+    in_section = False
+    with open(md_path) as f:
+        for line in f:
+            stripped = line.strip()
+            if stripped.startswith("## "):
+                in_section = stripped.startswith(_SCHEMA_HEADER)
+                continue
+            if not in_section:
+                continue
+            m = _ROW_NAME.match(stripped)
+            if m and m.group(1) not in ("metric",):  # skip header row
+                out.add(m.group(1))
+    return out
+
+
+def audit(pkg_dir: str, md_path: str) -> dict:
+    """{ok, registered, documented, undocumented: [...], stale: [...]}"""
+    reg = registered_names(pkg_dir)
+    doc = documented_names(md_path)
+    undocumented = sorted(set(reg) - doc)
+    stale = sorted(doc - set(reg))
+    return {
+        "ok": not undocumented and not stale and bool(doc),
+        "registered": reg,
+        "documented": doc,
+        "undocumented": undocumented,
+        "stale": stale,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="audit obs metric names against the "
+                    "OBSERVABILITY.md schema table"
+    )
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap.add_argument("--pkg", default=os.path.join(here, "fast_tffm_tpu"),
+                    help="package directory to scan")
+    ap.add_argument("--md", default=os.path.join(here, "OBSERVABILITY.md"),
+                    help="markdown file holding the schema table")
+    args = ap.parse_args(argv)
+    result = audit(args.pkg, args.md)
+    print(
+        f"obs metric audit: {len(result['registered'])} registered, "
+        f"{len(result['documented'])} documented"
+    )
+    if not result["documented"]:
+        print(f"  ! no '{_SCHEMA_HEADER}' table found in {args.md}")
+    for name in result["undocumented"]:
+        sites = ", ".join(result["registered"][name][:3])
+        print(f"  ! {name}: registered in code ({sites}) but missing "
+              f"from the schema table — document it")
+    for name in result["stale"]:
+        print(f"  ! {name}: in the schema table but no code registers "
+              f"it — remove the row or fix the name")
+    if not result["ok"]:
+        return 1
+    print("ok: code registry and schema table agree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

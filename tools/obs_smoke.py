@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Live-observability smoke: a real 20-step CLI run with --status_port,
+scraped over HTTP while it trains.
+
+tools/verify.sh runs this before the tier-1 gate.  It exercises the
+exact production path — ``run_tffm.py train <cfg> --status_port`` in a
+SUBPROCESS (pinned to CPU), not an in-process Trainer — and asserts:
+
+1. ``/status`` answers mid-run with well-formed JSON carrying the
+   heartbeat-record shape (``record``, ``step``, ``stages``);
+2. ``/metrics`` answers non-empty, every line Prometheus-parseable
+   (``# HELP``/``# TYPE`` comments or ``name{labels} value``), and
+   includes the core series;
+3. the run itself exits 0.
+
+Exit 0 = all three held; any other exit fails the audit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One sample line per Prometheus text-format metric: bare name or
+# name{labels}, then a number (int/float/scientific/inf/nan).
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"[-+]?(\d+\.?\d*([eE][-+]?\d+)?|\.\d+|[Ii]nf|[Nn]a[Nn])$"
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _gen_data(path: str, n_lines: int = 640, vocab: int = 50) -> None:
+    import random
+
+    rng = random.Random(0)
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            feats = rng.sample(range(vocab), 3)
+            toks = " ".join(
+                f"{i}:{rng.uniform(0.1, 1.0):.3f}" for i in feats
+            )
+            f.write(f"{rng.randint(0, 1)} {toks}\n")
+
+
+def _scrape_both(port: int, deadline: float, proc) -> tuple:
+    """(status_bytes, metrics_bytes) fetched back-to-back mid-run.
+
+    The server is up for the whole of train() (it outlives jit compile
+    and every dispatch), so one retry loop covers both routes; a child
+    that dies before answering fails fast instead of burning the
+    deadline.
+    """
+    base = f"http://127.0.0.1:{port}"
+    last_err = None
+    while time.time() < deadline:
+        try:
+            status = urllib.request.urlopen(
+                f"{base}/status", timeout=2).read()
+            metrics = urllib.request.urlopen(
+                f"{base}/metrics", timeout=2).read()
+            return status, metrics
+        except (urllib.error.URLError, OSError) as e:
+            last_err = e
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                sys.stderr.write(out.decode(errors="replace")[-2000:])
+                raise SystemExit(
+                    f"FAIL: run exited {proc.returncode} before the "
+                    f"status endpoint answered ({e})"
+                )
+            time.sleep(0.1)
+    raise SystemExit(f"FAIL: {base} unreachable before deadline "
+                     f"({last_err})")
+
+
+def check_prometheus(text: str) -> int:
+    """Validate Prometheus exposition text; returns the sample count."""
+    samples = 0
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        if not _SAMPLE.match(line):
+            raise SystemExit(
+                f"FAIL: /metrics line {lineno} is not Prometheus-"
+                f"parseable: {line!r}"
+            )
+        samples += 1
+    if samples == 0:
+        raise SystemExit("FAIL: /metrics served zero samples")
+    return samples
+
+
+def main() -> int:
+    port = _free_port()
+    tmpdir = tempfile.mkdtemp(prefix="tffm_obs_smoke_")
+    try:
+        return _run(port, tmpdir)
+    finally:
+        # verify.sh runs this on every invocation; leaked data/model
+        # dirs would accumulate on CI boxes.
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _run(port: int, tmpdir: str) -> int:
+    data = os.path.join(tmpdir, "train.libsvm")
+    _gen_data(data)  # 640 lines / batch 32 = the 20-step run
+    cfg_path = os.path.join(tmpdir, "smoke.cfg")
+    with open(cfg_path, "w") as f:
+        f.write(f"""[General]
+vocabulary_size = 50
+factor_num = 4
+model_file = {tmpdir}/model
+[Train]
+train_files = {data}
+epoch_num = 1
+batch_size = 32
+log_steps = 0
+thread_num = 2
+heartbeat_secs = 0.2
+metrics_file = {tmpdir}/metrics.jsonl
+[Tpu]
+max_features = 4
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "run_tffm.py"), "train",
+         cfg_path, "--status_port", str(port)],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.time() + 180
+        status_raw, metrics_raw = _scrape_both(port, deadline, proc)
+        status = json.loads(status_raw)
+        for key in ("record", "step", "stages"):
+            if key not in status:
+                raise SystemExit(
+                    f"FAIL: /status record missing {key!r}: {status}"
+                )
+        if status["record"] != "status":
+            raise SystemExit(
+                f"FAIL: /status record type {status['record']!r}"
+            )
+        metrics = metrics_raw.decode()
+        n = check_prometheus(metrics)
+        for series in ("tffm_step", "tffm_counter_ingest_examples_total",
+                       "tffm_timer_train_dispatch_count"):
+            if series not in metrics:
+                raise SystemExit(
+                    f"FAIL: /metrics missing core series {series}"
+                )
+        out, _ = proc.communicate(timeout=180)
+        if proc.returncode != 0:
+            sys.stderr.write(out.decode(errors="replace")[-2000:])
+            raise SystemExit(
+                f"FAIL: training run exited {proc.returncode}"
+            )
+        print(
+            f"obs smoke ok: /status step={status['step']}, /metrics "
+            f"served {n} Prometheus samples, run exited 0"
+        )
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,10 +28,23 @@ Three modes (see OBSERVABILITY.md):
    → deliver → stack → H2D → dispatch with the slowest chains broken
    down segment by segment.
 
+   Rotated trace windows (``trace_rotate_events``; ``trace.0.json,
+   trace.1.json, ...``) are re-joined automatically: windows sharing
+   one run's clock anchors are concatenated back into a single stream
+   before chain reconstruction, so chains that SPAN a rotation
+   boundary still connect.  With more than one rank stream, a
+   straggler section attributes each chain segment (parse / stack /
+   h2d / dispatch) to the slowest rank.
+
 3. ``--compare A B``: ratio-diff two runs — metrics JSONLs or bench
    JSONs (BENCH_rN.json) — and flag regressions beyond ``--threshold``
    (default 5%).  Rates/ratios regress when they FALL; times/fractions
-   /losses regress when they RISE.  Exit code 2 when any regression is
+   /losses regress when they RISE.  ``--threshold`` repeats for
+   per-key overrides (``--threshold ingest_wait_frac=0.10 --threshold
+   default=0.05``), so noisy keys get slack without loosening the whole
+   gate.  Alert records (``record: alert``, the watchdog's output)
+   contribute ``alerts_total`` / per-rule counts — a run that starts
+   alerting is itself a regression.  Exit code 2 when any regression is
    flagged, so the BENCH trajectory check stops being eyeball-only.
 
 Dependency-free on purpose: it must run on any box the artifacts land
@@ -211,6 +224,34 @@ def _print_breakdown(rec: dict) -> None:
             )
 
 
+def _print_alerts(alerts: list, limit: int = 8) -> None:
+    """Watchdog summary: per-rule fire counts + the most recent
+    alerts.  A halt rule is the headline — it is why the run stopped."""
+    if not alerts:
+        return
+    per_rule: dict = {}
+    for a in alerts:
+        per_rule.setdefault(a.get("rule", "?"), []).append(a)
+    n_halt = sum(1 for a in alerts if a.get("action") == "halt")
+    print(f"\nalerts ({len(alerts)} fired"
+          + (f", {n_halt} HALT" if n_halt else "") + "):")
+    print(f"  {'rule':36} {'fires':>6} {'action':>6}  last value")
+    for rule in sorted(per_rule):
+        rows = per_rule[rule]
+        last = rows[-1]
+        print(
+            f"  {rule:36} {len(rows):>6} {last.get('action', '?'):>6}  "
+            f"{last.get('signal')}={last.get('value')} at step "
+            f"{last.get('step')}"
+        )
+    for a in alerts[-limit:]:
+        print(
+            f"    step {a.get('step', '?'):>6}  {a.get('rule')}: "
+            f"{a.get('signal')}={a.get('value')} {a.get('op')} "
+            f"{a.get('threshold')} -> {a.get('action')}"
+        )
+
+
 def _stream_rank(groups: dict, fallback: int) -> int:
     headers = groups.get("run_header", [])
     if headers and "rank" in headers[-1]:
@@ -240,19 +281,20 @@ def _merge_ranks(streams: list) -> int:
             print("  ! config fingerprints DIFFER across ranks:", fps)
     print("\nper-rank attribution:")
     print(f"  {'rank':>4} {'step':>8} {'elapsed':>9} {'wait_frac':>9} "
-          f"{'examples_in':>12}  verdict")
+          f"{'examples_in':>12} {'alerts':>6}  verdict")
     slowest = None
     for rank, path, groups, final in rows:
         if final is None:
-            print(f"  {rank:>4} {'?':>8} {'?':>9} {'?':>9} {'?':>12}  "
-                  f"no final/heartbeat record ({path})")
+            print(f"  {rank:>4} {'?':>8} {'?':>9} {'?':>9} {'?':>12} "
+                  f"{'?':>6}  no final/heartbeat record ({path})")
             continue
         frac = final.get("ingest_wait_frac", 0.0)
         verdict = "ingest-bound" if frac > 0.25 else "compute-bound"
         print(
             f"  {rank:>4} {final.get('step', 0):>8} "
             f"{final.get('elapsed', 0.0):>9.1f} {frac:>9.3f} "
-            f"{final.get('examples_in', 0):>12}  {verdict}"
+            f"{final.get('examples_in', 0):>12} "
+            f"{len(groups.get('alert', [])):>6}  {verdict}"
         )
         if slowest is None or frac > slowest[1].get("ingest_wait_frac", 0):
             slowest = (rank, final)
@@ -285,16 +327,17 @@ def merge_traces(paths: list) -> tuple[list, list, list]:
     one host.  Across hosts each file's ``otherData`` anchors give the
     wall-clock offset; events are shifted onto the wall timeline and
     re-zeroed at the earliest event.  Returns (events, notes,
-    per_file_events) — the per-file lists are the UNSHIFTED originals,
-    for chain reconstruction (which is per-rank and only needs
-    intra-file deltas), so a near-cap 250 MB trace is parsed once.
+    per_file) — ``per_file`` entries are ``(path, events, otherData)``
+    with the UNSHIFTED original events, for chain reconstruction (which
+    is per-rank and only needs intra-file deltas), so a near-cap 250 MB
+    trace is parsed once.
     """
     notes = []
     all_events = []
     per_file = []
     for path in paths:
         events, other = load_trace(path)
-        per_file.append(events)
+        per_file.append((path, events, other))
         shift = 0
         if "wall_anchor" in other and "perf_anchor" in other:
             shift = int(
@@ -316,6 +359,103 @@ def merge_traces(paths: list) -> tuple[list, list, list]:
             if "ts" in ev:
                 ev["ts"] -= t0
     return all_events, notes, per_file
+
+
+def group_streams(per_file: list) -> list:
+    """Re-join rotated trace windows into per-run streams.
+
+    A rotated tracer (``trace_rotate_events``) dumps one run as
+    ``trace.0.json .. trace.N.json``; every window carries the SAME
+    clock anchors + pid and its ``window`` index in ``otherData``.
+    Windows sharing (pid, wall_anchor, perf_anchor) are one stream —
+    concatenated in window order so chains that span a rotation
+    boundary reconnect.  Files without a ``window`` key (unrotated
+    traces, one per rank) each stay their own stream, preserving the
+    per-rank chain contract (sb/seq ids restart per rank).
+
+    Returns ``[(label, events), ...]``.
+    """
+    singles = []
+    windowed: dict = {}
+    for path, events, other in per_file:
+        if "window" in other:
+            key = (
+                other.get("pid"),
+                other.get("wall_anchor"),
+                other.get("perf_anchor"),
+            )
+            windowed.setdefault(key, []).append(
+                (other["window"], path, events)
+            )
+        else:
+            singles.append((path, events))
+    streams = list(singles)
+    for key in sorted(windowed, key=str):
+        wins = sorted(windowed[key], key=lambda w: w[0])
+        events: list = []
+        for _, _, evs in wins:
+            events.extend(evs)
+        label = f"{wins[0][1]} (+{len(wins) - 1} window(s))" \
+            if len(wins) > 1 else wins[0][1]
+        streams.append((label, events))
+    return streams
+
+
+def _straggler_section(stream_chains: list, limit: int = 8) -> None:
+    """Slowest-rank attribution per chain segment.
+
+    ``stream_chains`` is ``[(label, chains), ...]`` — one entry per
+    rank stream.  For each stream the mean duration of every chain
+    segment (parse / stack / h2d / dispatch) and the mean end-to-end
+    chain latency are tabulated; the slowest rank per segment is named.
+    In a synchronous-update fleet the step waits for every host, so
+    the slowest rank per segment is where fleet time actually goes —
+    the groundwork for straggler detection (ROADMAP direction 4).
+    """
+    segs = ("parse", "stack", "h2d", "dispatch")
+    rows = []
+    for label, chains in stream_chains:
+        if not chains:
+            continue
+        sums = {s: 0.0 for s in segs}
+        counts = {s: 0 for s in segs}
+        lat = 0.0
+        for c in chains:
+            lat += c["latency_us"]
+            for name, (_, dur) in _chain_segments(c).items():
+                sums[name] += dur
+                counts[name] += 1
+        rows.append({
+            "label": label,
+            "chains": len(chains),
+            "lat_ms": lat / len(chains) / 1e3,
+            **{
+                s: (sums[s] / counts[s] / 1e3 if counts[s] else 0.0)
+                for s in segs
+            },
+        })
+    if len(rows) < 2:
+        return
+    print("\nstraggler attribution (mean ms per chain segment, "
+          "per rank stream):")
+    print(f"  {'stream':40} {'chains':>6} "
+          + "".join(f"{s:>9}" for s in segs) + f" {'latency':>9}")
+    for r in rows[:limit]:
+        label = r["label"]
+        if len(label) > 40:
+            label = "..." + label[-37:]
+        print(
+            f"  {label:40} {r['chains']:>6} "
+            + "".join(f"{r[s]:>9.2f}" for s in segs)
+            + f" {r['lat_ms']:>9.2f}"
+        )
+    for s in segs + ("lat_ms",):
+        worst = max(rows, key=lambda r: r[s])
+        if worst[s] <= 0:
+            continue
+        name = "latency" if s == "lat_ms" else s
+        print(f"  slowest {name:9}: {worst['label']} "
+              f"({worst[s]:.2f} ms mean)")
 
 
 def trace_chains(events: list) -> list:
@@ -468,12 +608,21 @@ def trace_mode(paths: list, out: str, limit: int) -> int:
     print("open in https://ui.perfetto.dev (or chrome://tracing)")
     for note in notes:
         print(f"  ! {note}")
-    # Chains are reconstructed PER RANK FILE: sb/seq/batch ids restart
-    # per rank, so joining across the merged pool would cross-wire the
-    # ranks' super-batches.
+    # Chains are reconstructed PER RANK STREAM: sb/seq/batch ids
+    # restart per rank, so joining across the merged pool would
+    # cross-wire the ranks' super-batches.  Rotated windows of one run
+    # (shared clock anchors + a window index) are first re-joined into
+    # their stream so chains spanning a rotation boundary reconnect.
+    streams = group_streams(per_file)
+    if len(streams) < len(per_file):
+        print(f"  re-joined {len(per_file)} file(s) into "
+              f"{len(streams)} stream(s) (rotated trace windows)")
+    stream_chains = [
+        (label, trace_chains(evs)) for label, evs in streams
+    ]
     chains = []
-    for evs in per_file:
-        chains.extend(trace_chains(evs))
+    for _, cs in stream_chains:
+        chains.extend(cs)
 
     spans: dict = {}
     for ev in events:
@@ -515,6 +664,7 @@ def trace_mode(paths: list, out: str, limit: int) -> int:
             prev_end = ts + dur
         print(f"  sb {c['sb']:>5}: {c['latency_us'] / 1e3:9.2f} ms  "
               f"[ms: {' -> '.join(parts)}]")
+    _straggler_section(stream_chains, limit)
     return 0
 
 
@@ -545,12 +695,23 @@ _DIRECTION_OVERRIDES = {
     "tiered.hot_hit_frac": "high",
     "tiered.rows_evicted": None, "tiered.rows_loaded": None,
     "trace_dropped_events": "low",
+    # Live observability plane: endpoint overhead is a cost ratio
+    # (off/on, like trace_overhead — rising means the endpoint slows
+    # training); rotated windows are informational; a run that starts
+    # ALERTING regressed even when its rates held.
+    "status_endpoint_overhead": "low",
+    "trace_windows": None,
+    "alerts_total": "low", "alerts_halt": "low",
 }
 
 
 def _direction(key: str):
     if key in _DIRECTION_OVERRIDES:
         return _DIRECTION_OVERRIDES[key]
+    # Watchdog per-rule fire counts (alert.<rule-name>): more fires of
+    # any rule is the regression, whatever signal the rule watches.
+    if key.startswith("alert."):
+        return "low"
     for suffix in _LOWER_BETTER:
         if key.endswith(suffix) or key == suffix:
             return "low"
@@ -597,6 +758,18 @@ def _comparable_metrics(path: str) -> dict:
             out[f"tiered.{key}"] = float(val)
     if "trace_dropped_events" in final:
         out["trace_dropped_events"] = float(final["trace_dropped_events"])
+    # Watchdog output: total fires, halts, and per-rule counts — all
+    # present (0) whenever the stream has records at all, so a run that
+    # STARTS alerting flags against a clean baseline (a key missing
+    # from one side would silently drop out of the comparison).
+    alerts = groups.get("alert", [])
+    out["alerts_total"] = float(len(alerts))
+    out["alerts_halt"] = float(
+        sum(1 for a in alerts if a.get("action") == "halt")
+    )
+    for a in alerts:
+        key = f"alert.{a.get('rule', '?')}"
+        out[key] = out.get(key, 0.0) + 1.0
     if final.get("elapsed") and final.get("examples_in"):
         out["examples_in_per_sec"] = (
             final["examples_in"] / final["elapsed"]
@@ -616,14 +789,44 @@ def _comparable_metrics(path: str) -> dict:
     return out
 
 
-def compare_mode(path_a: str, path_b: str, threshold: float) -> int:
+def parse_thresholds(values) -> dict:
+    """``--threshold`` values -> {key_or_"default": fraction}.
+
+    Accepted forms (repeatable, later wins): a bare float (``0.07`` —
+    sets the default, the historical spelling), ``default=0.05``, and
+    per-key overrides (``ingest_wait_frac=0.10``).  The watchdog and
+    the bench gates share one regression vocabulary this way: the same
+    key names that appear in ``--compare`` output key the overrides.
+    """
+    out = {"default": 0.05}
+    for raw in values or []:
+        raw = raw.strip()
+        if "=" in raw:
+            key, _, val = raw.partition("=")
+            key = key.strip()
+        else:
+            key, val = "default", raw
+        try:
+            out[key] = float(val)
+        except ValueError:
+            raise SystemExit(
+                f"--threshold {raw!r}: expected FLOAT or KEY=FLOAT"
+            ) from None
+    return out
+
+
+def compare_mode(path_a: str, path_b: str, thresholds: dict) -> int:
     a, b = _comparable_metrics(path_a), _comparable_metrics(path_b)
     shared = sorted(set(a) & set(b))
     if not shared:
         print("no comparable numeric keys shared by the two files")
         return 1
+    default = thresholds.get("default", 0.05)
+    overrides = {k: v for k, v in thresholds.items() if k != "default"}
     print(f"comparing A={path_a}  ->  B={path_b} "
-          f"(flag threshold {threshold:.0%})")
+          f"(flag threshold {default:.0%}"
+          + (f", {len(overrides)} per-key override(s)" if overrides
+             else "") + ")")
     print(f"  {'key':40} {'A':>12} {'B':>12} {'B/A':>8}  flag")
     regressions = []
     for key in shared:
@@ -632,6 +835,7 @@ def compare_mode(path_a: str, path_b: str, threshold: float) -> int:
             continue
         ratio = vb / va if va else float("inf")
         direction = _direction(key)
+        threshold = thresholds.get(key, default)
         flag = ""
         if direction == "high" and ratio < 1 - threshold:
             flag = "REGRESSION"
@@ -641,7 +845,9 @@ def compare_mode(path_a: str, path_b: str, threshold: float) -> int:
             flag = "improved"
         elif direction == "low" and ratio < 1 - threshold:
             flag = "improved"
-        if flag == "REGRESSION":
+        if flag and key in thresholds:
+            flag += f" (thr {threshold:g})"
+        if flag.startswith("REGRESSION"):
             regressions.append(key)
         rs = f"{ratio:8.3f}" if ratio != float("inf") else "     inf"
         print(f"  {key:40} {va:>12.4g} {vb:>12.4g} {rs}  {flag}")
@@ -675,16 +881,23 @@ def main(argv=None) -> int:
     ap.add_argument("--compare", action="store_true",
                     help="ratio-diff exactly two runs (metrics JSONLs "
                          "or bench JSONs); exit 2 on regression")
-    ap.add_argument("--threshold", type=float, default=0.05,
+    ap.add_argument("--threshold", action="append", default=None,
+                    metavar="FLOAT|KEY=FLOAT",
                     help="--compare: regression flag threshold "
-                         "(default 0.05 = 5%%)")
+                         "(default 0.05 = 5%%); repeat for per-key "
+                         "overrides, e.g. --threshold "
+                         "ingest_wait_frac=0.10 --threshold "
+                         "default=0.05")
     args = ap.parse_args(argv)
     if args.trace:
         return trace_mode(args.paths, args.out, args.limit)
     if args.compare:
         if len(args.paths) != 2:
             ap.error("--compare takes exactly two paths")
-        return compare_mode(args.paths[0], args.paths[1], args.threshold)
+        return compare_mode(
+            args.paths[0], args.paths[1],
+            parse_thresholds(args.threshold),
+        )
     streams = []
     for path in args.paths:
         groups = load(path)
@@ -703,6 +916,7 @@ def main(argv=None) -> int:
     _print_progress(
         groups.get("train", []), groups.get("validation", []), args.limit
     )
+    _print_alerts(groups.get("alert", []), args.limit)
     # The final record is the exact end-of-run report; fall back to the
     # last heartbeat for a run that died mid-flight (that's the point of
     # heartbeats: the stream still says where the time went).

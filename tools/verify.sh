@@ -1,15 +1,16 @@
 #!/usr/bin/env bash
-# One-command correctness gate: the static tier-1 marker audit plus the
-# PINNED tier-1 pytest invocation from ROADMAP.md — builders and bench
+# One-command correctness gate: the static audits (tier-1 markers, obs
+# metric-name drift), the live-observability smoke, and the PINNED
+# tier-1 pytest invocation from ROADMAP.md — builders and bench
 # preflight run the exact same thing, so "it passed locally" and "the
 # gate passed" can never mean different commands.
 #
-#   tools/verify.sh            # audit + full tier-1 suite
-#   tools/verify.sh --audit    # audit only (milliseconds, no jax)
+#   tools/verify.sh            # audits + obs smoke + full tier-1 suite
+#   tools/verify.sh --audit    # static audits only (milliseconds, no jax)
 #
-# Exit: 0 = audit ok and tier-1 pytest exit 0; nonzero otherwise.  The
-# DOTS_PASSED line at the end is the machine-readable passed count the
-# driver compares against the recorded baseline.
+# Exit: 0 = every stage ok; nonzero otherwise.  The DOTS_PASSED line at
+# the end is the machine-readable passed count the driver compares
+# against the recorded baseline.
 
 set -u
 cd "$(dirname "$0")/.."
@@ -17,9 +18,19 @@ cd "$(dirname "$0")/.."
 echo "== tier-1 marker audit (tools/check_tier1.py) =="
 python tools/check_tier1.py --tests tests --root . || exit 1
 
+echo
+echo "== obs metric-name drift audit (tools/check_obs.py) =="
+python tools/check_obs.py || exit 1
+
 if [ "${1:-}" = "--audit" ]; then
     exit 0
 fi
+
+echo
+echo "== live observability smoke (tools/obs_smoke.py) =="
+# A real CLI run with --status_port: /metrics must serve parseable
+# Prometheus text and /status the heartbeat JSON, mid-run.
+JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 
 echo
 echo "== tier-1 pytest (pinned invocation from ROADMAP.md) =="
