@@ -55,6 +55,8 @@ import time
 
 import numpy as np
 
+from fast_tffm_tpu import obs as obs_mod  # stdlib-only; no jax import
+
 PER_CHIP_TARGET = 2_000_000 / 16  # BASELINE.md: 2M ex/s on v5e-16
 _PROBE_MARK = "BENCH_PROBE_OK"
 
@@ -340,7 +342,8 @@ def _bench_parse_only(files, cfg) -> float:
 
 def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
                k: int = 1, telemetry_enabled: bool = True,
-               tracer=None, status: bool = False) -> tuple:
+               tracer=None, status: bool = False,
+               resource: bool = False) -> tuple:
     """Examples/sec through BatchPipeline + DevicePrefetcher — the
     train() hot path: parse threads, the stacking/H2D transfer thread,
     and the K-step fused dispatch all overlapped.  ``warmup`` counts
@@ -375,6 +378,13 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     run's telemetry snapshot AND a scraper thread hitting ``/metrics``
     every 200 ms — the endpoint-overhead probe (endpoint on + scraped
     vs off) under a realistic Prometheus-ish cadence.
+
+    ``resource=True`` attaches a resource-plane sampler thread: RSS /
+    peak-RSS (``/proc`` reads) + the component byte gauges + the
+    compile-sentinel snapshot, every 200 ms — the marginal cost of the
+    resource plane's live sampling at an aggressive heartbeat-like
+    cadence (the AOT dispatch path itself is already in the baseline:
+    the trainer's cfg has resource_metrics on by default).
     """
     import threading
 
@@ -387,6 +397,27 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     status_server = None
     scrape_stop = threading.Event()
     scraper = None
+    res_sampler = None
+
+    def _start_resource():
+        nonlocal res_sampler
+
+        def _sample():
+            sent = getattr(trainer, "_sentinel", None)
+            while not scrape_stop.wait(0.2):
+                obs.read_rss()
+                gauges = tel.snapshot().get("gauges") or {}
+                sum(
+                    gauges.get(name, 0) or 0
+                    for name in ("ingest.ring_bytes",
+                                 "ingest.cache_bytes",
+                                 "prefetch.staging_bytes")
+                )
+                if sent is not None:
+                    sent.snapshot()
+
+        res_sampler = threading.Thread(target=_sample, daemon=True)
+        res_sampler.start()
 
     def _start_status():
         # Called inside the try below so a pipeline/prefetcher
@@ -455,6 +486,8 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     try:
         if status:
             _start_status()
+        if resource:
+            _start_resource()
         warmed = 0
         # sb label counts from the first super-batch CONSUMED, warmup
         # included, so the trace's train.dispatch args.sb stays aligned
@@ -503,6 +536,8 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
         scrape_stop.set()
         if scraper is not None:
             scraper.join()
+        if res_sampler is not None:
+            res_sampler.join()
         if status_server is not None:
             status_server.close()
         prefetcher.close()
@@ -753,6 +788,8 @@ def main() -> int:
     e2e_tel_off = 0.0
     e2e_trace_on, trace_events = 0.0, 0
     e2e_status_on = 0.0
+    e2e_resource_on = 0.0
+    bench_compile_s = 0.0
     bf16_rung, bf16_errors = None, []
     e2e_err = None
     cfg = None
@@ -810,6 +847,10 @@ def main() -> int:
             for _ in range(trials)
         ]
         step_rate = float(np.median(s_samples))
+        # Compile attribution so far (the step-only regions' K=8 + K=1
+        # scan compiles); the e2e block re-captures after its probes.
+        if getattr(trainer, "_sentinel", None) is not None:
+            bench_compile_s = trainer._sentinel.compile_s
 
         if args.mode == "e2e":
             try:
@@ -934,6 +975,27 @@ def main() -> int:
                             f"status endpoint probe: "
                             f"{type(e).__name__}: {e}"
                         )
+                    # Resource-plane overhead probe (the PR 8 pillar,
+                    # same paired shape): the identical K=8 e2e with
+                    # RSS + component-ledger + compile-sentinel
+                    # sampling at an aggressive 200 ms cadence.
+                    # resource_overhead = off/on rate ratio; budget
+                    # <= 1.05 like every other obs layer.
+                    try:
+                        e2e_resource_on, _, _, _, _ = _bench_e2e(
+                            trainer, cfg, files, warmup=4,
+                            epochs=epochs, k=K, resource=True,
+                        )
+                    except Exception as e:  # noqa: BLE001 - report only
+                        ladder_errors.append(
+                            f"resource probe: {type(e).__name__}: {e}"
+                        )
+                    # Compile-sentinel attribution for the BENCH JSON:
+                    # total train-step compile wall time this bench's
+                    # trainer paid (the AOT cache makes it exact).
+                    sent = getattr(trainer, "_sentinel", None)
+                    if sent is not None:
+                        bench_compile_s = sent.compile_s
                     # parse_processes scaling: drain the bare pipeline
                     # with thread workers vs a spawned process pool on
                     # the same files (no training attached).
@@ -1065,6 +1127,20 @@ def main() -> int:
         "status_endpoint_overhead": round(
             e2e_rate / e2e_status_on, 4
         ) if e2e_status_on > 0 and e2e_rate > 0 else 0.0,
+        # Resource-plane overhead: the same K=8 e2e with RSS/ledger/
+        # sentinel sampling at 200 ms.  off/on rate ratio, budget
+        # <= 1.05 — the sampler only reads /proc and lock-guarded
+        # snapshots, so ~1.0 = free.
+        "e2e_resource_on_examples_per_sec": round(e2e_resource_on, 1),
+        "resource_overhead": round(
+            e2e_rate / e2e_resource_on, 4
+        ) if e2e_resource_on > 0 and e2e_rate > 0 else 0.0,
+        # Memory & compile attribution of the bench process itself:
+        # peak RSS over the whole bench (epoch caches + staged input +
+        # jit artifacts), and the train-step compile seconds the AOT
+        # sentinel accounted.  --compare gates both (low).
+        "peak_rss_mb": round(obs_mod.read_rss()[1] / (1 << 20), 1),
+        "compile_s": round(bench_compile_s, 3),
         "parse_lines_per_sec": round(parse_rate, 1),
         # Bare-pipeline drain rates: thread workers vs a spawned
         # parse-process pool on the same files (GIL-free scaling probe).

@@ -144,6 +144,12 @@ def build_argparser() -> argparse.ArgumentParser:
              "without overwriting the checkpoint",
     )
     p.add_argument(
+        "--no_resource_metrics", action="store_true",
+        help="disable the resource plane: no RSS/component-memory "
+             "ledger, no compile sentinel (the train step dispatches "
+             "through the plain jit path), no `resource` record block",
+    )
+    p.add_argument(
         "--trace_rotate_events", type=int, default=None,
         help="rotate the trace buffer into trace.0.json, trace.1.json, "
              "... every N events (removes the in-memory cap for long "
@@ -203,6 +209,8 @@ def main(argv=None) -> int:
     }
     if args.no_telemetry:
         overrides["telemetry"] = False
+    if args.no_resource_metrics:
+        overrides["resource_metrics"] = False
     cfg = load_config(args.cfg, overrides or None)
     _setup_logging(cfg.log_file or None)
     dist = _resolve_dist(args)

@@ -186,6 +186,21 @@ class FmConfig:
     # action halt raises AlertHaltError at the next dispatch boundary
     # without overwriting the checkpoint.  "" = off.
     alert_rules: str = ""
+    # Resource & compile observability (obs/resource.py): a `resource`
+    # block in every heartbeat/status/final record — process RSS +
+    # peak-RSS, the component host-memory ledger (SHM ring, staging
+    # pool, epoch cache, tiered cold store, trace buffer byte gauges),
+    # device memory (backend memory_stats where supported, a
+    # shape-derived table+optimizer estimate elsewhere), and the
+    # compile sentinel: the train-step compile path runs through an
+    # AOT (.lower().compile()) cache that counts compilations, records
+    # wall time + XLA cost analysis per compile (`record: compile`
+    # JSONL entries), and flags any recompile beyond the documented
+    # epoch-tail K'=leftover as `recompiles_unexpected` (warn by
+    # default; alert signal of the same name).  Off = no sentinel, no
+    # resource block, the historical jit dispatch path — bit-identical
+    # training, same contract as every other obs knob.
+    resource_metrics: bool = True
     # Windowed trace rotation: when the tracer's buffer reaches this
     # many events it dumps and resets, producing trace.0.json,
     # trace.1.json, ... (merge with tools/report.py --trace) — removes
@@ -332,9 +347,11 @@ class FmConfig:
             # startup, not silently at the first heartbeat.  The obs
             # module is stdlib-only, so this import is cheap and safe
             # here.
-            from fast_tffm_tpu.obs.alerts import parse_rules
+            from fast_tffm_tpu.obs.alerts import (
+                parse_rules, resolved_signal,
+            )
 
-            parse_rules(self.alert_rules)
+            rules = parse_rules(self.alert_rules)
             # The watchdog rides the heartbeat thread: rules without a
             # heartbeat would NEVER evaluate — for a halt rule that is
             # a safety mechanism silently inert, the one config bug
@@ -345,6 +362,23 @@ class FmConfig:
                     "watchdog evaluates rules on the heartbeat "
                     "thread; without one no rule would ever fire)"
                 )
+            # Same inertness hazard one plane over: a rule watching the
+            # heartbeat's `resource` block (recompiles_unexpected,
+            # rss_mb, ...) is non-evaluable on every beat when the
+            # resource plane is off.
+            if not self.resource_metrics:
+                inert = [
+                    r.signal for r in rules
+                    if resolved_signal(r.signal).startswith("resource.")
+                ]
+                if inert:
+                    raise ValueError(
+                        f"alert_rules watch resource-plane signals "
+                        f"{inert} but resource_metrics is off — the "
+                        "heartbeat would carry no resource block and "
+                        "these rules could never fire; enable "
+                        "resource_metrics or drop the rules"
+                    )
         if self.cache_max_bytes <= 0:
             raise ValueError(
                 f"cache_max_bytes must be positive, got {self.cache_max_bytes}"
@@ -443,6 +477,7 @@ _KEYMAP = {
     "status_port": ("status_port", int),
     "status_host": ("status_host", str),
     "alert_rules": ("alert_rules", str),
+    "resource_metrics": ("resource_metrics", _parse_bool),
     "trace_rotate_events": ("trace_rotate_events", int),
     "max_features": ("max_features", int),
     "mesh_data": ("mesh_data", int),

@@ -437,6 +437,12 @@ class BatchPipeline:
         self._c_ring_fallback = tel.counter("ingest.ring_fallback_windows")
         self._c_ring_bytes = tel.counter("ingest.ring_window_bytes")
         self._c_q_msg_bytes = tel.counter("ingest.work_msg_bytes")
+        # Component memory ledger (resource plane): the bytes this
+        # pipeline is RESPONSIBLE for right now — the epoch cache's
+        # retained batches (raw or prestacked; drops to 0 on overflow)
+        # and the SHM ring's fixed slot allocation (0 once torn down).
+        self._g_cache_bytes = tel.gauge("ingest.cache_bytes")
+        self._g_ring_bytes = tel.gauge("ingest.ring_bytes")
         # Always-real counter (not gated on telemetry): out-of-range-id
         # batches are a data/vocabulary integrity signal the trainer
         # surfaces in its RESULTS, not just in logs or optional stages.
@@ -633,10 +639,12 @@ class BatchPipeline:
                         )
                         cache = None
                         self.cache_result = "overflow"
+                        self._g_cache_bytes.set(0)  # retained nothing
                         if not deliver:
                             break  # rebuild-only parse: stop early
                     else:
                         cache.append(item)
+                        self._g_cache_bytes.set(size)
                 n_seen += 1
                 if deliver and n_seen > skip:
                     yield item
@@ -728,8 +736,10 @@ class BatchPipeline:
                     )
                     cache = None
                     self.cache_result = "overflow"
+                    self._g_cache_bytes.set(0)  # retained nothing
                 else:
                     cache.append(sb)
+                    self._g_cache_bytes.set(size)
             if not deliver:
                 return None
             if start_idx >= skip:
@@ -1114,6 +1124,8 @@ class BatchPipeline:
             ring = procpool.ShmRing.create(
                 shm_tag, cfg.ring_slots, self._ring_slot_bytes()
             )
+            # Ledger: the ring is a fixed allocation for its lifetime.
+            self._g_ring_bytes.set(cfg.ring_slots * ring.slot_bytes)
             ring_free = ctx.Queue(maxsize=cfg.ring_slots + 1)
             for i in range(cfg.ring_slots):
                 ring_free.put(i)
@@ -1341,6 +1353,7 @@ class BatchPipeline:
                 pass
             if ring is not None:
                 ring.destroy()
+                self._g_ring_bytes.set(0)  # allocation gone
             qs = (work, out) if ring_free is None else (
                 work, out, ring_free
             )
@@ -1454,7 +1467,8 @@ class _StagingPool:
     K' < K get their own small slot.
     """
 
-    def __init__(self, limit: int, reuse_counter=None, tracer=None):
+    def __init__(self, limit: int, reuse_counter=None, tracer=None,
+                 bytes_gauge=None):
         self._free: dict = {}  # key -> [Batch bufset, ...]
         self._inflight: deque = deque()  # (dev, key, bufset)
         self._limit = max(1, limit)
@@ -1463,6 +1477,14 @@ class _StagingPool:
             else obs.NULL.counter("")
         )
         self._tracer = tracer if tracer is not None else obs.NULL_TRACER
+        # Ledger: bytes of staging buffers this pool OWNS (free +
+        # in-flight).  Alias mode hands ownership to the zero-copy
+        # device array, so those bytes leave the ledger at retire.
+        self._bytes = 0
+        self._g_bytes = (
+            bytes_gauge if bytes_gauge is not None
+            else obs.NULL.gauge("")
+        )
         # Whether put_fn's device arrays ALIAS the host staging buffers
         # (None = not yet probed).  jax.device_put on a single-device
         # CPU mesh is zero-copy: the "device" array shares memory with
@@ -1528,7 +1550,10 @@ class _StagingPool:
         if free:
             self._c_reuse.add(1)
             return free.pop()
-        return self._alloc(group, key[2])
+        bufs = self._alloc(group, key[2])
+        self._bytes += _batch_nbytes(bufs)
+        self._g_bytes.set(self._bytes)
+        return bufs
 
     @staticmethod
     def _probe_alias(dev, bufs: libsvm.Batch) -> bool:
@@ -1569,7 +1594,10 @@ class _StagingPool:
                     "super-batches; stacking allocates fresh buffers"
                 )
         if self._alias_mode:
-            return  # the device array owns this memory now
+            # The device array owns this memory now — it left the pool.
+            self._bytes = max(0, self._bytes - _batch_nbytes(bufs))
+            self._g_bytes.set(self._bytes)
+            return
         self._inflight.append((dev, self._key(group), bufs))
 
 
@@ -1637,6 +1665,7 @@ class DevicePrefetcher:
                 max(1, depth) + 1,
                 reuse_counter=tel.counter("prefetch.staging_reuse"),
                 tracer=self._tracer,
+                bytes_gauge=tel.gauge("prefetch.staging_bytes"),
             )
             if staging else None
         )

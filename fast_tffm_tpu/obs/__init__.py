@@ -11,15 +11,18 @@ every stage, correlated per batch/super-batch (trace.py), with windowed
 rotation for multi-hour runs; ``obs.StatusServer`` serves ``/metrics``
 (Prometheus) + ``/status`` (heartbeat JSON) live from a running
 process (status.py); ``obs.AlertEngine`` evaluates declarative alert
-rules against the heartbeat stream (alerts.py).  See telemetry.py for
-the shared design constraints (thread-safety, near-zero hot-path
-overhead, no jax or numpy imports).
+rules against the heartbeat stream (alerts.py); ``obs.CompileSentinel``
+/ ``obs.read_rss`` are the resource plane (resource.py) — component
+memory ledgers, process RSS, and train-step compile accounting.  See
+telemetry.py for the shared design constraints (thread-safety,
+near-zero hot-path overhead, no jax or numpy imports).
 """
 
 from fast_tffm_tpu.obs.alerts import (
     AlertEngine, AlertHaltError, AlertRule, parse_rules,
 )
 from fast_tffm_tpu.obs.heartbeat import Heartbeat, JsonlWriter
+from fast_tffm_tpu.obs.resource import CompileSentinel, read_rss
 from fast_tffm_tpu.obs.status import StatusServer, render_prometheus
 from fast_tffm_tpu.obs.telemetry import (
     NULL, Counter, DepthHist, Gauge, Telemetry, Timing, trace_span,
@@ -31,4 +34,5 @@ __all__ = [
     "trace_span", "Heartbeat", "JsonlWriter", "Tracer", "NULL_TRACER",
     "StatusServer", "render_prometheus",
     "AlertEngine", "AlertHaltError", "AlertRule", "parse_rules",
+    "CompileSentinel", "read_rss",
 ]

@@ -94,7 +94,23 @@ _ALIASES = {
     "grad_norm_rms": "health.grad_norm_rms",
     "nonfinite_steps": "health.nonfinite_steps",
     "hot_hit_frac": "tiered.hot_hit_frac",
+    # Resource plane (the heartbeat's `resource` block): an unexpected
+    # mid-run recompile or a climbing RSS are exactly the signals an
+    # operator writes one-line rules for.
+    "recompiles_unexpected": "resource.recompiles_unexpected",
+    "peak_rss_mb": "resource.peak_rss_mb",
+    "rss_mb": "resource.rss_mb",
+    "compile_s": "resource.compile_s",
 }
+
+
+def resolved_signal(signal: str) -> str:
+    """The dotted heartbeat path a rule's signal resolves to (alias
+    expansion only — derived and already-dotted signals pass through
+    unchanged).  Lets config validation reason about WHERE a rule
+    reads from, e.g. refusing resource-plane rules when the resource
+    block is disabled."""
+    return _ALIASES.get(signal, signal)
 
 
 class AlertHaltError(RuntimeError):

@@ -12,7 +12,15 @@ host needs the same answers WHILE it runs, from standard tooling.
   (``ingest_wait_frac``, ``step``, ...), ready for a Prometheus scrape;
 - ``/status`` — the same JSON record a heartbeat would emit, built on
   demand (``record: status``);
-- ``/healthz`` — liveness probe (200 ``ok`` while the run is alive).
+- ``/healthz`` — liveness probe (200 ``ok`` while the run is alive);
+- ``/debug/threadz`` — an all-thread stack dump (stdlib
+  ``sys._current_frames``): the hang-diagnosis tool for a pipeline
+  with reader / parse-worker / prefetcher / heartbeat / status
+  threads — when the run wedges, this names the frame every thread is
+  stuck in, no gdb required;
+- ``/profile?secs=N`` — an on-demand ``jax.profiler`` capture window
+  (the owner supplies the capture callable; absent -> 404).  Strictly
+  one at a time: a second request while one is in flight gets 409.
 
 Design constraints, shared with the rest of ``obs/``:
 
@@ -37,11 +45,14 @@ from __future__ import annotations
 import json
 import logging
 import re
+import sys
 import threading
+import traceback
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-__all__ = ["StatusServer", "render_prometheus"]
+__all__ = ["StatusServer", "render_prometheus", "thread_dump"]
 
 log = logging.getLogger(__name__)
 
@@ -61,6 +72,37 @@ def _num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
+_LABEL_ESC = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def _label_value(v) -> str:
+    return "".join(_LABEL_ESC.get(ch, ch) for ch in str(v))
+
+
+def thread_dump() -> str:
+    """One text block per live thread: name/ident/daemon + its current
+    stack (``sys._current_frames``).  Pure stdlib, read-only, safe to
+    call from a request handler at any time — the tool you want when a
+    multi-thread pipeline stops making progress."""
+    frames = sys._current_frames()
+    lines = []
+    for t in sorted(threading.enumerate(), key=lambda t: t.name):
+        lines.append(
+            f"--- thread {t.name!r} (ident={t.ident}, "
+            f"daemon={t.daemon}, alive={t.is_alive()}) ---"
+        )
+        frame = frames.get(t.ident)
+        if frame is None:
+            lines.append("  <no frame (not started or already gone)>")
+        else:
+            lines.extend(
+                ln.rstrip("\n")
+                for ln in traceback.format_stack(frame)
+            )
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
 def render_prometheus(record: dict) -> str:
     """Render one heartbeat-shaped record as Prometheus text exposition.
 
@@ -77,7 +119,13 @@ def render_prometheus(record: dict) -> str:
       ``_mean`` / ``_max`` plus per-band ``_bucket{band="1-3"}`` gauges
       (occupancy bands, not cumulative ``le`` buckets);
     - ``health.*`` -> ``tffm_health_<key>`` gauges;
-    - ``tiered.*`` -> ``tffm_tiered_<key>`` gauges.
+    - ``tiered.*`` -> ``tffm_tiered_<key>`` gauges;
+    - ``resource.*`` -> ``tffm_resource_<key>`` gauges (RSS, component
+      byte ledger, compile counters, FLOPs attribution);
+    - ``build_info`` (a dict of strings) -> one ``tffm_build_info``
+      info-style gauge whose LABELS carry the run identity (jax
+      version, backend, mesh, K), value always 1 — the Prometheus
+      idiom for making every scrape self-identifying across runs.
     """
     lines: list = []
 
@@ -118,9 +166,19 @@ def render_prometheus(record: dict) -> str:
             lines.append(f"# TYPE {base}_bucket gauge")
             for band, n in buckets.items():
                 lines.append(f'{base}_bucket{{band="{band}"}} {n}')
-    for block in ("health", "tiered"):
+    for block in ("health", "tiered", "resource"):
         for key, val in sorted((record.get(block) or {}).items()):
             emit(f"tffm_{block}_{_prom_name(key)}", val)
+    info = record.get("build_info")
+    if isinstance(info, dict) and info:
+        labels = ",".join(
+            f'{_prom_name(str(k))}="{_label_value(v)}"'
+            for k, v in sorted(info.items())
+        )
+        lines.append("# HELP tffm_build_info run identity labels "
+                     "(value is always 1)")
+        lines.append("# TYPE tffm_build_info gauge")
+        lines.append(f"tffm_build_info{{{labels}}} 1")
     return "\n".join(lines) + "\n"
 
 
@@ -135,13 +193,20 @@ class StatusServer:
     unauthenticated, so publishing beyond the host (a real Prometheus
     scrape) is an explicit opt-in (``status_host = 0.0.0.0``).
     ``telemetry`` (optional) receives a ``status.requests`` counter so
-    scrape load shows up in snapshots.  ``close()`` shuts the server
-    down and joins its thread; idempotent.
+    scrape load shows up in snapshots.  ``profile`` (optional) is the
+    on-demand capture callable ``profile(secs) -> output_dir`` behind
+    ``/profile?secs=N`` — the server only guards it (one capture at a
+    time; a concurrent request gets 409) and clamps ``secs`` to
+    [0.1, 120]; without it the route 404s.  ``close()`` shuts the
+    server down and joins its thread; idempotent.
     """
 
     def __init__(self, port: int, build: Callable[[], Optional[dict]],
-                 telemetry=None, host: str = "127.0.0.1"):
+                 telemetry=None, host: str = "127.0.0.1",
+                 profile: Optional[Callable[[float], str]] = None):
         self._build = build
+        self._profile = profile
+        self._profile_lock = threading.Lock()
         self._requests = (
             telemetry.counter("status.requests")
             if telemetry is not None else None
@@ -162,9 +227,15 @@ class StatusServer:
             def do_GET(self) -> None:  # noqa: N802 - http.server API
                 if server._requests is not None:
                     server._requests.add()
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/healthz":
                     self._send(200, b"ok\n", "text/plain")
+                    return
+                if path == "/debug/threadz":
+                    self._send(200, thread_dump().encode(), "text/plain")
+                    return
+                if path == "/profile":
+                    self._do_profile(query)
                     return
                 if path not in ("/metrics", "/status"):
                     self._send(404, b"not found\n", "text/plain")
@@ -186,6 +257,47 @@ class StatusServer:
                         200, body,
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
+
+            def _do_profile(self, query: str) -> None:
+                """On-demand profiler window.  Blocks THIS handler
+                thread for the capture (other routes keep answering —
+                ThreadingHTTPServer); the non-blocking lock acquire is
+                the one-at-a-time guard (two overlapping jax profiler
+                traces would poison each other)."""
+                if server._profile is None:
+                    self._send(
+                        404, b"profiler not available on this run\n",
+                        "text/plain",
+                    )
+                    return
+                params = urllib.parse.parse_qs(query)
+                try:
+                    secs = float(params.get("secs", ["2"])[0])
+                except ValueError:
+                    self._send(400, b"secs must be a number\n",
+                               "text/plain")
+                    return
+                secs = min(max(secs, 0.1), 120.0)
+                if not server._profile_lock.acquire(blocking=False):
+                    self._send(
+                        409, b"a profile capture is already in "
+                             b"progress\n", "text/plain",
+                    )
+                    return
+                try:
+                    out = server._profile(secs)
+                except Exception as e:  # noqa: BLE001 - report, don't die
+                    self._send(
+                        500, f"profile capture failed: {e}\n".encode(),
+                        "text/plain",
+                    )
+                    return
+                finally:
+                    server._profile_lock.release()
+                body = (json.dumps(
+                    {"profile_dir": out, "secs": secs}
+                ) + "\n").encode()
+                self._send(200, body, "application/json")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True

@@ -241,6 +241,22 @@ class Tracer:
         if rotate:
             self._maybe_rotate()
 
+    # In-memory cost of one buffered event dict, estimated: a span is a
+    # small dict of short strings/ints (~120-250 B serialized) whose
+    # CPython representation (dict + boxed values) runs ~2x that.  The
+    # resource ledger wants an order-of-magnitude byte figure without
+    # sizeof-walking a million events under the append lock.
+    _EVENT_EST_BYTES = 400
+
+    @property
+    def buffer_bytes(self) -> int:
+        """Estimated host bytes held by the in-memory event buffer —
+        the tracer's entry in the component memory ledger (rotation
+        bounds it at ~rotate_events * 400 B; unrotated traces grow to
+        the cap)."""
+        with self._lock:
+            return len(self._events) * self._EVENT_EST_BYTES
+
     @property
     def dropped_events(self) -> int:
         """Events discarded at the buffer cap so far.  A nonzero value

@@ -25,6 +25,7 @@ import dataclasses
 import hashlib
 import json
 import logging
+import os
 import time
 from functools import partial
 from typing import NamedTuple, Optional
@@ -525,6 +526,42 @@ class Trainer:
             out_shardings=(state_sh, health_sh),
             donate_argnums=0,
         )
+        # Resource plane (obs/resource.py): the fused-scan dispatch runs
+        # through an AOT compile cache (.lower().compile() keyed on the
+        # super-batch's exact shapes/dtypes/structure) so every compile
+        # is an explicit, timed, cost-analyzed event the CompileSentinel
+        # accounts for — instead of an invisible stall inside jit
+        # dispatch.  The documented epoch-tail K' < K compile is
+        # whitelisted; anything else (batch-shape drift, a sort-meta
+        # presence flip, a foreign K) bumps recompiles_unexpected and
+        # warns.  resource_metrics=off skips the cache entirely — the
+        # historical implicit-jit path, bit-identical training.
+        self._compile_cache: dict = {}
+        self._primary_rest = None  # non-leading shape sig of compile #1
+        self._aot_broken = False  # toolchain drift -> permanent fallback
+        # A short-k compile is whitelisted PROVISIONALLY: a real epoch
+        # tail is followed by the EpochEnd marker (or end of stream),
+        # so the dispatch loop confirms the boundary and reclassifies
+        # the compile as unexpected if any other super-batch follows.
+        self._tail_probation = None  # (k, step) awaiting confirmation
+        self._sentinel = (
+            obs.CompileSentinel(
+                telemetry=self.telemetry,
+                expected_k=cfg.steps_per_dispatch,
+            )
+            if cfg.resource_metrics else None
+        )
+        self._dispatches = 0  # per-run dispatch count (throughput attr.)
+        self._run_steps = 0  # per-run step count, visible to the sentinel
+        # Shape-derived device-memory estimate: table + optimizer-slot
+        # bytes of the DEVICE state (with tiering on, the hot tables).
+        # The truth where the backend reports it (memory_stats on TPU);
+        # this is the documented CPU fallback, computed once.
+        self._state_bytes_est = int(sum(
+            x.nbytes for x in jax.tree.leaves(
+                (self.state.params, self.state.opt_state)
+            )
+        ))
         ms_sh = jax.tree.map(lambda _: rep, MetricState.zeros())
         self._eval_step = jax.jit(
             make_eval_step(cfg),
@@ -784,11 +821,206 @@ class Trainer:
         bench step timing and the resume tests wrap exactly this — while
         threading the health carry through ``self._health`` (monitors
         never change the TrainState math, so scan parity with K single
-        ``_train_step`` calls stays bitwise)."""
-        state, self._health = self._scan_health_jit(
-            state, self._health, batches
-        )
+        ``_train_step`` calls stays bitwise).  With the resource plane
+        on, dispatch goes through the AOT compile cache so the compile
+        sentinel sees every (re)compilation; the executable is the same
+        lowering jit would have produced, so the math is identical
+        either way."""
+        if self._sentinel is not None and not self._aot_broken:
+            fn = self._compiled_scan(state, batches)
+        else:
+            fn = self._scan_health_jit
+        state, self._health = fn(state, self._health, batches)
         return state
+
+    def _compiled_scan(self, state: TrainState, batches: Batch):
+        """AOT compile cache for the fused-scan step.
+
+        Keyed on the super-batch's pytree structure + per-leaf
+        shape/dtype (structure matters: a sort_meta that flips between
+        present and None retraces, and that flip is exactly a silent
+        recompile worth flagging).  A miss compiles explicitly
+        (``.lower().compile()``), timed and cost-analyzed for the
+        sentinel.  Expected compiles: the first ever (startup), and an
+        epoch-tail K' < steps_per_dispatch whose non-leading shapes
+        match the first compile's — whitelisted provisionally, then
+        confirmed by the dispatch loop (an epoch boundary must follow;
+        see _resolve_tail_probation).  Any API drift in the AOT path
+        degrades permanently to the implicit-jit call — observability
+        must never take down the training it observes."""
+        leaves, treedef = jax.tree_util.tree_flatten(batches)
+        key = (treedef, tuple((x.shape, str(x.dtype)) for x in leaves))
+        fn = self._compile_cache.get(key)
+        if fn is not None:
+            return fn
+        k = int(batches.labels.shape[0])
+        rest = tuple(x.shape[1:] for x in leaves)
+        try:
+            t0 = time.perf_counter()
+            with self.tracer.span("train.compile", args={"k": k}), \
+                    obs.trace_span("tffm:compile"):
+                fn = self._scan_health_jit.lower(
+                    state, self._health, batches
+                ).compile()
+            wall = time.perf_counter() - t0
+        except Exception as e:  # pragma: no cover - jax API drift
+            self._aot_broken = True
+            log.warning(
+                "AOT compile path unavailable (%s: %s); compile "
+                "sentinel disabled, dispatching through plain jit",
+                type(e).__name__, e,
+            )
+            return self._scan_health_jit
+        if self._primary_rest is None:
+            expected = True  # startup compile (whatever its K)
+            self._primary_rest = rest
+        else:
+            expected = (
+                rest == self._primary_rest
+                and k <= self._sentinel.expected_k
+            )
+            if expected and k < self._sentinel.expected_k:
+                # Provisional: only a real epoch tail earns the
+                # whitelist.  _resolve_tail_probation (dispatch loop)
+                # checks that an epoch boundary actually follows this
+                # super-batch and reclassifies if not.
+                self._tail_probation = (k, self._run_steps)
+        self._sentinel.record(
+            wall, k, expected, cost=self._cost_of(fn),
+            step=self._run_steps,
+        )
+        self._compile_cache[key] = fn
+        return fn
+
+    def _resolve_tail_probation(self, item) -> None:
+        """Confirm or refute a provisionally-whitelisted short-k
+        compile with what the pipeline delivered NEXT: an EpochEnd
+        marker or end of stream (``None``) confirms the epoch tail;
+        another super-batch means the stream is emitting short groups
+        mid-epoch — the drift class the sentinel exists to flag."""
+        if self._tail_probation is None:
+            return
+        k, step = self._tail_probation
+        self._tail_probation = None
+        if item is not None and not isinstance(item, EpochEnd):
+            self._sentinel.reclassify_unexpected(k, step)
+
+    @staticmethod
+    def _cost_of(compiled) -> dict:
+        """FLOPs / bytes from the compiled executable's XLA analyses.
+        Best-effort: backends disagree on what they report (and older
+        jax returns cost_analysis as a one-element list), so absent
+        numbers are simply omitted."""
+        out: dict = {}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if isinstance(ca, dict):
+                if ca.get("flops"):
+                    out["flops"] = float(ca["flops"])
+                if ca.get("bytes accessed"):
+                    out["bytes_accessed"] = float(ca["bytes accessed"])
+        except Exception:  # noqa: BLE001 - analysis is optional
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            for attr, name in (
+                ("output_size_in_bytes", "output_bytes"),
+                ("temp_size_in_bytes", "temp_bytes"),
+                ("argument_size_in_bytes", "argument_bytes"),
+            ):
+                v = int(getattr(ma, attr, 0) or 0)
+                if v:
+                    out[name] = v
+        except Exception:  # noqa: BLE001 - analysis is optional
+            pass
+        return out
+
+    def _device_mem(self) -> dict:
+        """Device-memory figures for the resource block: the backend's
+        allocator stats where supported (an allocator query, not a
+        device sync — safe at heartbeat cadence), else only the
+        shape-derived estimate computed at construction."""
+        out: dict = {}
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 - backend drift
+            stats = None
+        if stats:
+            if stats.get("bytes_in_use") is not None:
+                out["device_bytes_in_use"] = int(stats["bytes_in_use"])
+            if stats.get("peak_bytes_in_use") is not None:
+                out["device_peak_bytes"] = int(
+                    stats["peak_bytes_in_use"]
+                )
+        out["device_bytes_est"] = self._state_bytes_est
+        return out
+
+    def _resource_block(self, stages: dict, wall: float) -> dict:
+        """The ``resource`` record block (flat, numeric): process RSS,
+        the component byte ledger (read from the same telemetry gauges
+        their owners maintain), device memory, and the compile
+        sentinel's counters + throughput attribution.  Host-side only —
+        callable from heartbeat/status threads."""
+        rss, peak = obs.read_rss()
+        gauges = (stages or {}).get("gauges") or {}
+
+        def comp(name: str) -> int:
+            try:
+                return max(0, int(gauges.get(name, 0) or 0))
+            except (TypeError, ValueError):
+                return 0
+
+        out = {
+            "rss_mb": round(rss / (1 << 20), 1),
+            "peak_rss_mb": round(peak / (1 << 20), 1),
+        }
+        if self.telemetry.enabled:
+            # The owner-maintained gauges are no-op instruments when
+            # telemetry is off — a hard 0 next to a real RSS would be
+            # a lying ledger, so the keys are OMITTED (report.py
+            # prints n/a, /metrics emits no series).
+            out["ring_bytes"] = comp("ingest.ring_bytes")
+            out["staging_bytes"] = comp("prefetch.staging_bytes")
+            out["cache_bytes"] = comp("ingest.cache_bytes")
+        # Trainer-owned components read directly (no extra gauge —
+        # a registered sample would duplicate the same number in
+        # every /metrics scrape): cold-store nbytes are plain int
+        # attributes, the tracer property takes its own lock.
+        out["cold_store_bytes"] = (
+            int(sum(s.nbytes for s in self.tiered.stores))
+            if self.tiered is not None else 0
+        )
+        out["trace_buffer_bytes"] = int(self.tracer.buffer_bytes)
+        out.update(self._device_mem())
+        snap = self._sentinel.snapshot()
+        out.update(snap)
+        flops = snap.get("flops_per_dispatch", 0.0)
+        if flops and wall > 0 and self._dispatches:
+            # Model FLOP/s from the steady-state dispatch's compile-time
+            # cost analysis (epoch tails run fewer flops, so this is a
+            # mild overestimate on short epochs — attribution, not
+            # billing).
+            out["model_flops_per_s"] = round(
+                flops * self._dispatches / wall, 1
+            )
+        return out
+
+    def _ondemand_profile(self, secs: float) -> str:
+        """/profile route backend: one jax.profiler window into the run
+        dir.  The StatusServer's lock is the one-at-a-time guard; a
+        clash with the config-driven profiler (profile_dir) raises and
+        surfaces as the route's 500."""
+        out = self._profile_capture_dir
+        jax.profiler.start_trace(out)
+        try:
+            time.sleep(secs)
+        finally:
+            jax.profiler.stop_trace()
+        log.info("on-demand profiler capture (%.1fs) written to %s",
+                 secs, out)
+        return out
 
     def _reset_health(self) -> None:
         """Fresh per-run health carry (mirrors telemetry.reset).
@@ -1089,6 +1321,7 @@ class Trainer:
                 "nan_policy": cfg.nan_policy,
                 "status_port": cfg.status_port,
                 "alert_rules": cfg.alert_rules,
+                "resource_metrics": cfg.resource_metrics,
                 "jax_version": jax.__version__,
                 "backend": jax.default_backend(),
                 "mesh": {str(a): int(n) for a, n in self.mesh.shape.items()},
@@ -1118,6 +1351,34 @@ class Trainer:
         # device and its scalars are already on the host.
         self._reset_health()
         self._health_host = {}
+        # Resource plane, per-run: fresh sentinel accounting (the AOT
+        # cache itself is instance-lived — a second train() on a warm
+        # Trainer truthfully reports zero compiles) and the run's
+        # writer for `record: compile` entries.
+        self._dispatches = 0
+        self._run_steps = 0
+        self._tail_probation = None
+        if self._sentinel is not None:
+            self._sentinel.reset()
+            self._sentinel.set_writer(metrics_out)
+        # /profile captures land beside the metrics stream (or cwd).
+        self._profile_capture_dir = os.path.join(
+            os.path.dirname(cfg.metrics_file) or ".",
+            "tffm_profile_ondemand",
+        )
+        # /metrics self-identification: one info-style gauge whose
+        # labels name the run (tffm_build_info) so scrapes from
+        # different runs/configs are distinguishable in Prometheus.
+        self._build_info = {
+            "jax_version": jax.__version__,
+            "backend": jax.default_backend(),
+            "mesh": "x".join(
+                f"{a}{n}" for a, n in self.mesh.shape.items()
+            ),
+            "steps_per_dispatch": str(k),
+            "rank": str(jax.process_index()),
+            "config_fingerprint": _config_fingerprint(cfg),
+        }
         if self.tiered is not None:
             self.tiered.reopen()  # re-arm after a cancelled prior run
         pending_health = None  # (nonfinite_arr, grad_sq_arr, grad_sq_sum_arr, stepno)
@@ -1277,6 +1538,18 @@ class Trainer:
                 "health": self._health_summary(exact=(kind == "final")),
                 "stages": self.telemetry.snapshot(),
             }
+            if self._sentinel is not None:
+                # Memory & compile self-report (obs/resource.py): RSS,
+                # the component byte ledger, device memory, compile
+                # sentinel counters, FLOP/s attribution.  Host-side
+                # reads only — safe on the heartbeat/status threads.
+                rec["resource"] = self._resource_block(
+                    rec["stages"], wall
+                )
+            if kind == "status":
+                # Scrapes are self-identifying: /metrics renders this
+                # as the tffm_build_info info-style gauge.
+                rec["build_info"] = dict(self._build_info)
             if kind == "status" and stepno == 0:
                 # Same over-count the heartbeat path suppresses by
                 # skipping the beat (see the docstring): before the
@@ -1344,10 +1617,12 @@ class Trainer:
                 status_server = obs.StatusServer(
                     cfg.status_port, partial(telemetry_record, "status"),
                     telemetry=self.telemetry, host=cfg.status_host,
+                    profile=self._ondemand_profile,
                 )
                 log.info(
                     "status endpoint listening on %s:%d "
-                    "(/metrics, /status, /healthz)", cfg.status_host,
+                    "(/metrics, /status, /healthz, /debug/threadz, "
+                    "/profile)", cfg.status_host,
                     status_server.port,
                 )
             except OSError as e:
@@ -1374,6 +1649,9 @@ class Trainer:
                         "train.wait_input"
                     ):
                         item = next(source, None)
+                    # A short-k compile from the PREVIOUS dispatch is
+                    # only a legit epoch tail if a boundary follows it.
+                    self._resolve_tail_probation(item)
                     if item is None:
                         break
                     if isinstance(item, EpochEnd):
@@ -1425,6 +1703,11 @@ class Trainer:
                     dispatch_idx += 1
                     stepno += kk
                     self._batches_done += kk
+                    # Resource-plane attribution state: dispatch count
+                    # for model_flops_per_s, and the step the compile
+                    # sentinel stamps on `record: compile` entries.
+                    self._dispatches = dispatch_idx
+                    self._run_steps = stepno
                     # Health readback, one dispatch delayed: start an
                     # async D2H copy of THIS dispatch's scalars, then
                     # consume the PREVIOUS dispatch's (already resident —
@@ -1665,6 +1948,10 @@ class Trainer:
         train_metrics["health"] = dict(
             self._final_record.get("health", {})
         )
+        if "resource" in self._final_record:
+            train_metrics["resource"] = dict(
+                self._final_record["resource"]
+            )
         if self.tiered is not None:
             train_metrics["tiered"] = dict(
                 self._final_record.get("tiered", {})

@@ -81,6 +81,20 @@ class TestParseRules:
             heartbeat_secs=30,
         )  # ok
 
+    def test_resource_rules_refused_when_plane_off(self):
+        """A rule watching the `resource` block can never evaluate with
+        resource_metrics=off — same silently-inert hazard the
+        heartbeat_secs check closes, so config refuses it at startup."""
+        for sig in ("recompiles_unexpected > 0 : halt",
+                    "rss_mb > 4000 : warn",
+                    "resource.compile_s > 10 : warn"):
+            with pytest.raises(ValueError, match="resource-plane"):
+                FmConfig(alert_rules=sig, heartbeat_secs=30,
+                         resource_metrics=False)
+        # With the plane on (the default) the same rules are fine.
+        FmConfig(alert_rules="recompiles_unexpected > 0 : halt",
+                 heartbeat_secs=30)
+
 
 def _rec(**kw) -> dict:
     rec = {"record": "heartbeat", "step": kw.pop("step", 1)}

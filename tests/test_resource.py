@@ -1,0 +1,674 @@
+"""Resource & compile observability (ISSUE 8 tentpole).
+
+Pins the resource plane's guarantees:
+
+  * ``obs.read_rss`` reports sane process RSS / peak-RSS;
+  * the ``CompileSentinel`` accounts compiles, flags unexpected ones
+    (telemetry counter + JSONL ``record: compile`` entries), and the
+    trainer's AOT cache classifies the documented epoch-tail K'
+    compile as EXPECTED while a shape-drift recompile is flagged and
+    fires the ``recompiles_unexpected`` alert alias;
+  * a ``resource`` block rides every heartbeat / final record (crash
+    path included) and train results;
+  * ``resource_metrics = off`` is bit-identical training (no sentinel,
+    no block — the same contract as every other obs knob);
+  * the component memory-ledger gauges reconcile with the actual
+    allocation sizes (epoch cache, SHM ring, staging pool);
+  * ``tools/report.py`` loads streams WITHOUT the block cleanly and
+    ``--compare`` gates the new resource keys in the right direction.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.libsvm import Batch
+from fast_tffm_tpu.data.pipeline import (
+    BatchPipeline, _batch_nbytes, _StagingPool, stack_batches,
+)
+from fast_tffm_tpu.train.loop import Trainer
+
+import os
+import sys
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+)
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import report  # noqa: E402
+
+
+def _write_libsvm(path, n_lines, vocab=50, n_feat=3, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            feats = rng.choice(vocab, size=n_feat, replace=False)
+            toks = " ".join(f"{i}:{rng.uniform(0.1, 1):.3f}" for i in feats)
+            f.write(f"{rng.integers(0, 2)} {toks}\n")
+    return str(path)
+
+
+def _cfg(data, tmp_path, tag, **kw):
+    defaults = dict(
+        vocabulary_size=50,
+        factor_num=4,
+        model_file=str(tmp_path / f"model_{tag}"),
+        train_files=[data],
+        epoch_num=1,
+        batch_size=32,
+        max_features=4,
+        log_steps=0,
+        thread_num=2,
+        steps_per_dispatch=4,
+        seed=3,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+@pytest.fixture(scope="module")
+def train_file(tmp_path_factory):
+    out = tmp_path_factory.mktemp("res_data")
+    # 320 lines / batch 32 = 10 batches; K=4 -> two full dispatches +
+    # one epoch-tail dispatch at K'=2 (the whitelisted extra compile).
+    return _write_libsvm(out / "train.libsvm", 320)
+
+
+def _batch(rng, b=32, f=4, vocab=50):
+    return Batch(
+        labels=rng.integers(0, 2, b).astype(np.float32),
+        ids=rng.integers(0, vocab, (b, f)).astype(np.int32),
+        vals=rng.uniform(0.1, 1.0, (b, f)).astype(np.float32),
+        fields=np.zeros((b, f), np.int32),
+        weights=np.ones((b,), np.float32),
+    )
+
+
+# ------------------------------------------------------------- read_rss
+
+
+class TestReadRss:
+    def test_reports_sane_values(self):
+        rss, peak = obs.read_rss()
+        assert rss > 1 << 20  # a python + jax process is >> 1 MiB
+        assert peak >= rss
+
+    def test_peak_is_monotonic(self):
+        _, peak0 = obs.read_rss()
+        _, peak1 = obs.read_rss()
+        assert peak1 >= peak0
+
+
+# ------------------------------------------------------- sentinel (unit)
+
+
+class _ListWriter:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+class TestCompileSentinel:
+    def test_accounting_and_instruments(self):
+        tel = obs.Telemetry()
+        w = _ListWriter()
+        s = obs.CompileSentinel(telemetry=tel, expected_k=4)
+        s.set_writer(w)
+        s.record(0.5, 4, True, cost={"flops": 100.0,
+                                     "bytes_accessed": 400.0}, step=0)
+        s.record(0.25, 2, True, cost={"flops": 50.0}, step=8)
+        s.record(0.125, 4, False, step=12)
+        snap = s.snapshot()
+        assert snap["compiles"] == 3
+        assert snap["compile_s"] == pytest.approx(0.875)
+        assert snap["recompiles_unexpected"] == 1
+        # Steady-state cost keeps the LARGEST-k compile's numbers.
+        assert snap["flops_per_dispatch"] == 100.0
+        assert snap["arithmetic_intensity"] == pytest.approx(0.25)
+        # Registry instruments: timer count == compiles; the
+        # unexpected counter is the alert signal's source.
+        assert tel.timer("train.compile").count == 3
+        assert tel.counter("train.recompiles_unexpected").value == 1
+        # JSONL entries are self-describing.
+        assert [r["record"] for r in w.records] == ["compile"] * 3
+        assert [r["expected"] for r in w.records] == [True, True, False]
+        assert w.records[0]["flops"] == 100.0
+
+    def test_reset_is_per_run(self):
+        s = obs.CompileSentinel(expected_k=2)
+        s.record(1.0, 2, True, cost={"flops": 10.0})
+        s.reset()
+        snap = s.snapshot()
+        assert snap["compiles"] == 0 and snap["compile_s"] == 0.0
+        # The cached executable's cost still describes what dispatches.
+        assert snap["flops_per_dispatch"] == 10.0
+
+    def test_writer_failure_never_raises(self):
+        class Bad:
+            def write(self, rec):
+                raise OSError("full volume")
+
+        s = obs.CompileSentinel()
+        s.set_writer(Bad())
+        s.record(0.1, 1, True)  # must not raise
+        assert s.snapshot()["compiles"] == 1
+
+
+# --------------------------------------------------- trainer integration
+
+
+class TestTrainerResource:
+    def test_resource_block_and_tail_whitelist(self, train_file,
+                                               tmp_path):
+        """The full-run contract: resource block in heartbeat + final +
+        results, `record: compile` entries, and the epoch-tail K'
+        compile whitelisted (no unexpected recompile, no alert)."""
+        mf = str(tmp_path / "metrics.jsonl")
+        cfg = _cfg(train_file, tmp_path, "res", metrics_file=mf,
+                   heartbeat_secs=0.05)
+        trainer = Trainer(cfg)
+        result = trainer.train()
+
+        records = [json.loads(line) for line in open(mf)]
+        beats = [r for r in records if r["record"] == "heartbeat"]
+        final = [r for r in records if r["record"] == "final"][-1]
+        compiles = [r for r in records if r["record"] == "compile"]
+
+        # Two compiles: the K=4 primary and the K'=2 epoch tail, both
+        # expected.
+        assert [c["k"] for c in compiles] == [4, 2]
+        assert all(c["expected"] for c in compiles)
+        assert all(c["compile_s"] > 0 for c in compiles)
+
+        for rec in beats + [final]:
+            res = rec.get("resource")
+            assert res, f"record {rec['record']} lacks resource block"
+            assert res["rss_mb"] > 1
+            assert res["peak_rss_mb"] >= res["rss_mb"]
+            assert res["device_bytes_est"] > 0
+        assert final["resource"]["compiles"] == 2
+        assert final["resource"]["recompiles_unexpected"] == 0
+        assert final["resource"]["compile_s"] > 0
+        # XLA cost analysis captured at compile time feeds throughput
+        # attribution (CPU backend reports flops, so these exist here).
+        assert final["resource"]["flops_per_dispatch"] > 0
+        assert final["resource"]["model_flops_per_s"] > 0
+        # Run header records the knob; results carry the block.
+        header = records[0]
+        assert header["resource_metrics"] is True
+        assert result["train"]["resource"]["compiles"] == 2
+
+        # The alert alias resolves into the block: a rule on the
+        # unexpected counter stays SILENT on this clean run...
+        engine = obs.AlertEngine(
+            obs.parse_rules("recompiles_unexpected > 0 : warn")
+        )
+        for rec in beats + [final]:
+            assert engine.observe(rec) == []
+        # ...and fires once the counter moves.
+        poisoned = dict(final)
+        poisoned["resource"] = dict(
+            final["resource"], recompiles_unexpected=1
+        )
+        fired = engine.observe(poisoned)
+        assert len(fired) == 1 and fired[0]["action"] == "warn"
+        assert fired[0]["signal"] == "recompiles_unexpected"
+
+    def test_shape_drift_recompile_flagged(self, train_file, tmp_path):
+        """A mid-run batch-shape change (here: a foreign K > the
+        configured steps_per_dispatch) is an UNEXPECTED recompile: the
+        sentinel counts it and the warn fires in the log."""
+        rng = np.random.default_rng(0)
+        cfg = _cfg(train_file, tmp_path, "drift", steps_per_dispatch=2)
+        trainer = Trainer(cfg)
+        put = trainer._put_super
+
+        sb2 = put(stack_batches([_batch(rng) for _ in range(2)]))
+        trainer.state = trainer._scan_train_step(trainer.state, sb2)
+        assert trainer._sentinel.unexpected == 0
+
+        # Epoch-tail K' < K: whitelisted.
+        sb1 = put(stack_batches([_batch(rng)]))
+        trainer.state = trainer._scan_train_step(trainer.state, sb1)
+        assert trainer._sentinel.unexpected == 0
+
+        # Foreign K > configured: flagged.
+        sb3 = put(stack_batches([_batch(rng) for _ in range(3)]))
+        trainer.state = trainer._scan_train_step(trainer.state, sb3)
+        assert trainer._sentinel.compiles == 3
+        assert trainer._sentinel.unexpected == 1
+        assert trainer.telemetry.counter(
+            "train.recompiles_unexpected"
+        ).value == 1
+
+    def test_short_k_tail_needs_epoch_boundary(self, train_file,
+                                               tmp_path):
+        """The tail whitelist is confirmed, not assumed: a short-k
+        compile followed by ANOTHER super-batch (not an EpochEnd /
+        end of stream) is reclassified unexpected — the mid-epoch
+        short-group drift class; a boundary-confirmed tail stays
+        whitelisted."""
+        from fast_tffm_tpu.data.pipeline import EpochEnd
+
+        rng = np.random.default_rng(1)
+        cfg = _cfg(train_file, tmp_path, "prob", steps_per_dispatch=2)
+        trainer = Trainer(cfg)
+        put = trainer._put_super
+
+        sb2 = put(stack_batches([_batch(rng) for _ in range(2)]))
+        trainer.state = trainer._scan_train_step(trainer.state, sb2)
+        assert trainer._tail_probation is None  # startup, whatever K
+
+        # Short-k compile -> probation armed; an EpochEnd confirms it.
+        sb1 = put(stack_batches([_batch(rng)]))
+        trainer.state = trainer._scan_train_step(trainer.state, sb1)
+        assert trainer._tail_probation is not None
+        trainer._resolve_tail_probation(EpochEnd(epoch=0))
+        assert trainer._tail_probation is None
+        assert trainer._sentinel.unexpected == 0
+
+        # Same short-k dispatch again: cached (no compile), so no new
+        # probation — repeat dispatches are not repeat compiles.
+        trainer.state = trainer._scan_train_step(trainer.state, sb1)
+        assert trainer._tail_probation is None
+
+        # A DIFFERENT short k compiling mid-epoch: the next item is a
+        # super-batch, so the provisional whitelist is revoked.
+        trainer2 = Trainer(
+            _cfg(train_file, tmp_path, "prob2", steps_per_dispatch=3)
+        )
+        put2 = trainer2._put_super
+        sbp = put2(stack_batches([_batch(rng) for _ in range(3)]))
+        trainer2.state = trainer2._scan_train_step(trainer2.state, sbp)
+        sbs = put2(stack_batches([_batch(rng)]))
+        trainer2.state = trainer2._scan_train_step(trainer2.state, sbs)
+        assert trainer2._tail_probation is not None
+        trainer2._resolve_tail_probation((sbp, 3))  # another super-batch
+        assert trainer2._sentinel.unexpected == 1
+        assert trainer2.telemetry.counter(
+            "train.recompiles_unexpected"
+        ).value == 1
+        # End of stream (None) also confirms: re-arm and resolve clean.
+        trainer2._tail_probation = (1, 9)
+        trainer2._resolve_tail_probation(None)
+        assert trainer2._sentinel.unexpected == 1
+
+    def test_resource_off_is_bit_identical(self, train_file, tmp_path):
+        """resource_metrics=off (no sentinel, plain jit dispatch) trains
+        bit-identically to on — the same contract as telemetry/trace/
+        status knobs."""
+        import jax
+
+        r_on = Trainer(
+            _cfg(train_file, tmp_path, "on", resource_metrics=True)
+        ).train()
+        t_off = Trainer(
+            _cfg(train_file, tmp_path, "off", resource_metrics=False)
+        )
+        r_off = t_off.train()
+        assert t_off._sentinel is None
+        assert "resource" not in r_off["train"]
+        assert r_on["train"]["loss"] == r_off["train"]["loss"]
+        assert r_on["train"]["auc"] == r_off["train"]["auc"]
+
+        # And the params agree bitwise (fresh trainers, same seed).
+        t_on2 = Trainer(
+            _cfg(train_file, tmp_path, "on2", resource_metrics=True)
+        )
+        t_off2 = Trainer(
+            _cfg(train_file, tmp_path, "off2", resource_metrics=False)
+        )
+        t_on2.train()
+        t_off2.train()
+        for a, b in zip(jax.tree.leaves(t_on2.state.params),
+                        jax.tree.leaves(t_off2.state.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_crash_truthful_final_carries_resource(self, train_file,
+                                                   tmp_path):
+        """A run that dies mid-flight still closes its stream with a
+        final record carrying the resource block (the block is built in
+        the same try/finally as the crash banner)."""
+        mf = str(tmp_path / "metrics.jsonl")
+        cfg = _cfg(train_file, tmp_path, "crash", metrics_file=mf)
+        trainer = Trainer(cfg)
+        real = trainer._scan_train_step
+        calls = []
+
+        def dies(state, batches):
+            if calls:
+                raise RuntimeError("injected mid-run death")
+            calls.append(1)
+            return real(state, batches)
+
+        trainer._scan_train_step = dies
+        with pytest.raises(RuntimeError, match="injected"):
+            trainer.train()
+        records = [json.loads(line) for line in open(mf)]
+        final = [r for r in records if r["record"] == "final"][-1]
+        assert final["exception"] == "RuntimeError"
+        res = final["resource"]
+        assert res["rss_mb"] > 1 and res["compiles"] == 1
+
+    def test_telemetry_off_omits_gauge_ledger(self, train_file,
+                                              tmp_path):
+        """With telemetry=off the owner-maintained ledger gauges are
+        no-op instruments — the block OMITS ring/staging/cache bytes
+        (report prints n/a) instead of reporting a lying 0 next to a
+        real RSS; directly-read components stay present."""
+        mf = str(tmp_path / "metrics.jsonl")
+        cfg = _cfg(train_file, tmp_path, "notel", telemetry=False,
+                   metrics_file=mf)
+        Trainer(cfg).train()
+        records = [json.loads(line) for line in open(mf)]
+        final = [r for r in records if r["record"] == "final"][-1]
+        res = final["resource"]
+        assert res["rss_mb"] > 1
+        for absent in ("ring_bytes", "staging_bytes", "cache_bytes"):
+            assert absent not in res
+        for present in ("cold_store_bytes", "trace_buffer_bytes",
+                        "compiles"):
+            assert present in res
+
+
+# ----------------------------------------------------- ledger gauges
+
+
+class TestLedgerGauges:
+    def test_cache_bytes_reconcile(self, tmp_path):
+        """ingest.cache_bytes == the summed nbytes of exactly the
+        batches the epoch cache retained."""
+        data = _write_libsvm(tmp_path / "t.libsvm", 192)
+        cfg = FmConfig(
+            vocabulary_size=50, factor_num=4, batch_size=32,
+            max_features=4, thread_num=2, cache_epochs=True,
+        )
+        tel = obs.Telemetry()
+        pipe = BatchPipeline(
+            [data], cfg, epochs=2, shuffle=True, ordered=True,
+            cache_epochs=True, telemetry=tel,
+        )
+        epoch0 = []
+        for i, b in enumerate(pipe):
+            if i < 6:  # 192/32 = 6 epoch-0 batches, then replays
+                epoch0.append(b)
+        expect = sum(_batch_nbytes(b) for b in epoch0)
+        got = tel.snapshot()["gauges"]["ingest.cache_bytes"]
+        assert got == expect
+        assert pipe.cache_result == "cached"
+
+    def test_cache_overflow_zeroes_gauge(self, tmp_path):
+        data = _write_libsvm(tmp_path / "t.libsvm", 192)
+        cfg = FmConfig(
+            vocabulary_size=50, factor_num=4, batch_size=32,
+            max_features=4, thread_num=2, cache_epochs=True,
+        )
+        tel = obs.Telemetry()
+        pipe = BatchPipeline(
+            [data], cfg, epochs=2, shuffle=True, ordered=True,
+            cache_epochs=True, cache_max_bytes=64, telemetry=tel,
+        )
+        for _ in pipe:
+            pass
+        assert pipe.cache_result == "overflow"
+        assert tel.snapshot()["gauges"]["ingest.cache_bytes"] == 0
+
+    def test_prestacked_cache_bytes_reconcile(self, tmp_path):
+        data = _write_libsvm(tmp_path / "t.libsvm", 192)
+        cfg = FmConfig(
+            vocabulary_size=50, factor_num=4, batch_size=32,
+            max_features=4, thread_num=2, cache_epochs=True,
+            cache_prestacked=True, steps_per_dispatch=2,
+        )
+        tel = obs.Telemetry()
+        pipe = BatchPipeline(
+            [data], cfg, epochs=2, shuffle=True, ordered=True,
+            cache_epochs=True, prestack_k=2, telemetry=tel,
+        )
+        supers = []
+        for item in pipe:
+            if len(supers) < 3:  # 6 batches / K=2 = 3 epoch-0 groups
+                supers.append(item)
+        expect = sum(_batch_nbytes(sb.batch) for sb in supers)
+        assert tel.snapshot()["gauges"]["ingest.cache_bytes"] == expect
+
+    def test_ring_bytes_reconcile(self, tmp_path):
+        """ingest.ring_bytes == slots x slot capacity while the SHM
+        ring lives, 0 after teardown."""
+        data = _write_libsvm(tmp_path / "t.libsvm", 256)
+        cfg = FmConfig(
+            vocabulary_size=50, factor_num=4, batch_size=32,
+            max_features=4, parse_processes=1, ring_slots=2,
+            shuffle_buffer=64,
+        )
+        tel = obs.Telemetry()
+        pipe = BatchPipeline(
+            [data], cfg, epochs=1, shuffle=True, ordered=True,
+            telemetry=tel,
+        )
+        seen_live = 0
+        for _ in pipe:
+            g = tel.snapshot()["gauges"].get("ingest.ring_bytes", 0)
+            if g:
+                seen_live = g
+        assert seen_live == 2 * pipe._ring_slot_bytes()
+        # The generator is exhausted -> the finally ran -> gauge zeroed.
+        assert tel.snapshot()["gauges"]["ingest.ring_bytes"] == 0
+
+    def test_staging_bytes_reconcile(self, rng):
+        """prefetch.staging_bytes tracks exactly the buffers the pool
+        owns: alloc adds, reuse doesn't, alias-mode handoff subtracts."""
+        tel = obs.Telemetry()
+        gauge = tel.gauge("prefetch.staging_bytes")
+        pool = _StagingPool(4, bytes_gauge=gauge)
+        group = [_batch(rng) for _ in range(2)]
+        bufs = pool.acquire(group)
+        assert gauge.value == _batch_nbytes(bufs)
+        # Retire behind a plain-numpy "device" batch (no aliasing with
+        # the staging buffers) -> stays pool-owned, then reuses.
+        dev = stack_batches(group)
+        pool.retire(dev, group, bufs)
+        assert gauge.value == _batch_nbytes(bufs)
+        # Drain in-flight and reacquire: reuse allocates nothing new.
+        for _ in range(4):
+            g2 = [_batch(rng) for _ in range(2)]
+            b2 = pool.acquire(g2)
+            pool.retire(stack_batches(g2), g2, b2)
+        assert gauge.value <= 5 * _batch_nbytes(bufs)
+
+    def test_staging_alias_handoff_subtracts(self, rng):
+        tel = obs.Telemetry()
+        gauge = tel.gauge("prefetch.staging_bytes")
+        pool = _StagingPool(2, bytes_gauge=gauge)
+        pool._alias_mode = True  # zero-copy backend: pool gives away
+        group = [_batch(rng) for _ in range(2)]
+        bufs = pool.acquire(group)
+        assert gauge.value == _batch_nbytes(bufs)
+        pool.retire(None, group, bufs)
+        assert gauge.value == 0
+
+
+# ------------------------------------------------------ status routes
+
+
+class TestCaptureRoutes:
+    def test_threadz_dumps_all_threads(self):
+        import threading
+        import urllib.request
+
+        server = obs.StatusServer(0, lambda: {"record": "status"})
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/threadz",
+                timeout=5,
+            ).read().decode()
+        finally:
+            server.close()
+        assert "--- thread" in body
+        assert "MainThread" in body
+        assert threading.current_thread().name in body
+
+    def test_profile_busy_guard(self):
+        import threading
+        import time
+        import urllib.error
+        import urllib.request
+
+        started = threading.Event()
+
+        def slow_profile(secs):
+            started.set()
+            time.sleep(0.5)
+            return "/tmp/out"
+
+        server = obs.StatusServer(
+            0, lambda: {"record": "status"}, profile=slow_profile
+        )
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            results = {}
+
+            def req_a():
+                results["a"] = json.loads(urllib.request.urlopen(
+                    f"{base}/profile?secs=9", timeout=10
+                ).read())
+
+            t = threading.Thread(target=req_a)
+            t.start()
+            assert started.wait(5)
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{base}/profile", timeout=10)
+            assert exc.value.code == 409
+            t.join()
+            assert results["a"]["profile_dir"] == "/tmp/out"
+            # The lock released: a later request succeeds again.
+            doc = json.loads(urllib.request.urlopen(
+                f"{base}/profile?secs=0.2", timeout=10
+            ).read())
+            assert doc["profile_dir"] == "/tmp/out"
+        finally:
+            server.close()
+
+    def test_profile_404_without_callable(self):
+        import urllib.error
+        import urllib.request
+
+        server = obs.StatusServer(0, lambda: {"record": "status"})
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/profile", timeout=5
+                )
+            assert exc.value.code == 404
+        finally:
+            server.close()
+
+    def test_build_info_renders_as_info_gauge(self):
+        text = obs.render_prometheus({
+            "record": "status",
+            "step": 3,
+            "resource": {"rss_mb": 12.5, "compiles": 1},
+            "build_info": {
+                "jax_version": "0.4.37", "backend": "cpu",
+                "mesh": "data1xmodel1", "steps_per_dispatch": "8",
+            },
+        })
+        assert "tffm_resource_rss_mb 12.5" in text
+        assert "tffm_resource_compiles 1" in text
+        line = [
+            ln for ln in text.splitlines()
+            if ln.startswith("tffm_build_info{")
+        ]
+        assert len(line) == 1
+        assert 'backend="cpu"' in line[0]
+        assert 'steps_per_dispatch="8"' in line[0]
+        assert line[0].endswith("} 1")
+
+
+# ----------------------------------------------------- report tooling
+
+
+class TestReportResource:
+    def _stream(self, path, resource=None):
+        recs = [
+            {"record": "run_header", "rank": 0,
+             "config_fingerprint": "x"},
+            {"record": "train", "step": 8, "examples": 256.0,
+             "loss": 0.5, "auc": 0.6, "examples_per_sec": 1000.0},
+        ]
+        final = {
+            "record": "final", "step": 8, "elapsed": 2.0,
+            "wait_input_s": 0.1, "dispatch_s": 1.0,
+            "ingest_wait_frac": 0.05, "examples_in": 256,
+        }
+        if resource is not None:
+            final["resource"] = resource
+        recs.append(final)
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    def test_stream_without_resource_loads_cleanly(self, tmp_path,
+                                                   capsys):
+        """Backward compatibility: pre-resource streams summarize with
+        an n/a note, never a KeyError."""
+        p = self._stream(tmp_path / "old.jsonl")
+        assert report.main([p]) == 0
+        out = capsys.readouterr().out
+        assert "memory & compile: n/a" in out
+
+    def test_stream_with_resource_summarizes(self, tmp_path, capsys):
+        p = self._stream(tmp_path / "new.jsonl", resource={
+            "rss_mb": 100.0, "peak_rss_mb": 120.0, "cache_bytes": 1024,
+            "compiles": 2, "compile_s": 1.5,
+            "recompiles_unexpected": 1, "model_flops_per_s": 1e9,
+        })
+        assert report.main([p]) == 0
+        out = capsys.readouterr().out
+        assert "memory & compile (resource block):" in out
+        assert "UNEXPECTED recompile" in out
+
+    def test_compare_directions(self, tmp_path, capsys):
+        """peak_rss_mb/compile_s/recompiles_unexpected regress when
+        they RISE; model_flops_per_s when it FALLS — and a resource-less
+        baseline never KeyErrors."""
+        a = self._stream(tmp_path / "a.jsonl", resource={
+            "peak_rss_mb": 100.0, "compile_s": 1.0,
+            "recompiles_unexpected": 0, "model_flops_per_s": 1e9,
+            "rss_mb": 90.0, "compiles": 2,
+        })
+        b = self._stream(tmp_path / "b.jsonl", resource={
+            "peak_rss_mb": 200.0, "compile_s": 2.0,
+            "recompiles_unexpected": 3, "model_flops_per_s": 5e8,
+            "rss_mb": 90.0, "compiles": 2,
+        })
+        rc = report.main(["--compare", a, b])
+        out = capsys.readouterr().out
+        assert rc == 2
+        for key in ("resource.peak_rss_mb", "resource.compile_s",
+                    "resource.model_flops_per_s"):
+            assert any(
+                key in ln and "REGRESSION" in ln
+                for ln in out.splitlines()
+            ), key
+        # The reverse comparison is all improvements (memory/compile
+        # fell, FLOP/s rose, recompiles vanished): exit 0.
+        rc2 = report.main(["--compare", b, a])
+        capsys.readouterr()
+        assert rc2 == 0
+
+    def test_compare_old_vs_new_no_keyerror(self, tmp_path):
+        a = self._stream(tmp_path / "old.jsonl")  # no resource block
+        b = self._stream(tmp_path / "new.jsonl", resource={
+            "peak_rss_mb": 100.0, "compile_s": 1.0,
+        })
+        # Shared keys only; resource.* drops out silently.
+        assert report.main(["--compare", a, b]) == 0

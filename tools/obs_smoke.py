@@ -7,13 +7,18 @@ exact production path — ``run_tffm.py train <cfg> --status_port`` in a
 SUBPROCESS (pinned to CPU), not an in-process Trainer — and asserts:
 
 1. ``/status`` answers mid-run with well-formed JSON carrying the
-   heartbeat-record shape (``record``, ``step``, ``stages``);
+   heartbeat-record shape (``record``, ``step``, ``stages``) plus the
+   resource block;
 2. ``/metrics`` answers non-empty, every line Prometheus-parseable
    (``# HELP``/``# TYPE`` comments or ``name{labels} value``), and
-   includes the core series;
-3. the run itself exits 0.
+   includes the core series + the ``tffm_build_info`` identity gauge;
+3. ``/debug/threadz`` serves an all-thread stack dump naming the
+   pipeline's threads;
+4. ``/profile?secs=N`` captures one profiler window mid-run, and its
+   busy-guard rejects a CONCURRENT second request with 409;
+5. the run itself exits 0.
 
-Exit 0 = all three held; any other exit fails the audit.
+Exit 0 = all held; any other exit fails the audit.
 """
 
 from __future__ import annotations
@@ -26,6 +31,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -48,7 +54,7 @@ def _free_port() -> int:
     return port
 
 
-def _gen_data(path: str, n_lines: int = 640, vocab: int = 50) -> None:
+def _gen_data(path: str, n_lines: int = 6400, vocab: int = 50) -> None:
     import random
 
     rng = random.Random(0)
@@ -109,6 +115,76 @@ def check_prometheus(text: str) -> int:
     return samples
 
 
+def _get(port: int, route: str, timeout: float = 30.0) -> tuple:
+    """(http_code, body bytes) — HTTPError codes return, not raise."""
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=timeout
+        )
+        return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def check_capture_routes(port: int) -> None:
+    """/debug/threadz + the /profile busy-guard, mid-run.
+
+    The guard contract: while one capture window is open, a second
+    request gets 409 — so request A (a 0.5 s window; the process's
+    FIRST capture also pays jax's one-time ~5 s profiler init, which
+    the guard covers too) runs on a thread, request B fires into the
+    middle of it, and both responses are asserted.  Runs right after
+    the first successful scrape — early in the run, so the sized-up
+    smoke run (see _run) cannot end under the open capture.
+    """
+    code, body = _get(port, "/debug/threadz")
+    if code != 200:
+        raise SystemExit(f"FAIL: /debug/threadz answered {code}")
+    text = body.decode(errors="replace")
+    if "--- thread" not in text or "MainThread" not in text:
+        raise SystemExit(
+            f"FAIL: /debug/threadz is not a thread dump: {text[:200]!r}"
+        )
+    results: dict = {}
+
+    def slow_profile():
+        # Store failures too: a connection reset (training subprocess
+        # dying mid-capture) must surface as a FAIL diagnostic below,
+        # not a KeyError in the main thread.
+        try:
+            results["a"] = _get(port, "/profile?secs=0.5", timeout=60)
+        except Exception as exc:
+            results["error"] = exc
+
+    t = threading.Thread(target=slow_profile)
+    t.start()
+    time.sleep(0.5)  # give A a head start toward the capture lock
+    code_b, body_b = _get(port, "/profile?secs=0.5")
+    t.join()
+    if "a" not in results:
+        raise SystemExit(
+            f"FAIL: /profile capture got no HTTP response "
+            f"(run died mid-capture?): {results.get('error')!r}"
+        )
+    # The guard contract is about the PAIR, not the order: on a loaded
+    # box request B can reach the lock first, so accept either winner —
+    # exactly one 200 (with a capture dir) and one 409.
+    pair = {"a": results["a"], "b": (code_b, body_b)}
+    codes = sorted(code for code, _ in pair.values())
+    if codes != [200, 409]:
+        raise SystemExit(
+            f"FAIL: concurrent /profile pair answered {codes}, wanted "
+            f"exactly one 200 and one busy-guard 409"
+        )
+    winner = next(body for code, body in pair.values() if code == 200)
+    doc = json.loads(winner)
+    if not doc.get("profile_dir"):
+        raise SystemExit(f"FAIL: /profile response names no dir: {doc}")
+    print(f"capture routes ok: threadz dumped "
+          f"{text.count('--- thread')} thread(s), /profile wrote "
+          f"{doc['profile_dir']}, concurrent request got 409")
+
+
 def main() -> int:
     port = _free_port()
     tmpdir = tempfile.mkdtemp(prefix="tffm_obs_smoke_")
@@ -122,7 +198,12 @@ def main() -> int:
 
 def _run(port: int, tmpdir: str) -> int:
     data = os.path.join(tmpdir, "train.libsvm")
-    _gen_data(data)  # 640 lines / batch 32 = the 20-step run
+    # 6400 lines x 20 epochs / batch 32 = 4000 steps (~20 s on a CPU
+    # box): long enough that the /profile capture — jax's one-time
+    # ~5 s profiler init plus the 0.5 s window — finishes well before
+    # the run does.  A 20-step run used to end UNDER the open capture
+    # and reset the connection.
+    _gen_data(data)
     cfg_path = os.path.join(tmpdir, "smoke.cfg")
     with open(cfg_path, "w") as f:
         f.write(f"""[General]
@@ -131,7 +212,7 @@ factor_num = 4
 model_file = {tmpdir}/model
 [Train]
 train_files = {data}
-epoch_num = 1
+epoch_num = 20
 batch_size = 32
 log_steps = 0
 thread_num = 2
@@ -150,8 +231,12 @@ max_features = 4
     try:
         deadline = time.time() + 180
         status_raw, metrics_raw = _scrape_both(port, deadline, proc)
+        # Capture routes first: the scrape above succeeded inside the
+        # startup/compile window, so the 2 s profile capture cannot
+        # outlive the run.
+        check_capture_routes(port)
         status = json.loads(status_raw)
-        for key in ("record", "step", "stages"):
+        for key in ("record", "step", "stages", "resource"):
             if key not in status:
                 raise SystemExit(
                     f"FAIL: /status record missing {key!r}: {status}"
@@ -160,10 +245,16 @@ max_features = 4
             raise SystemExit(
                 f"FAIL: /status record type {status['record']!r}"
             )
+        if "rss_mb" not in status["resource"]:
+            raise SystemExit(
+                f"FAIL: resource block has no rss_mb: "
+                f"{status['resource']}"
+            )
         metrics = metrics_raw.decode()
         n = check_prometheus(metrics)
         for series in ("tffm_step", "tffm_counter_ingest_examples_total",
-                       "tffm_timer_train_dispatch_count"):
+                       "tffm_timer_train_dispatch_count",
+                       "tffm_resource_rss_mb", "tffm_build_info"):
             if series not in metrics:
                 raise SystemExit(
                     f"FAIL: /metrics missing core series {series}"

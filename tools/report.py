@@ -5,7 +5,7 @@ Three modes (see OBSERVABILITY.md):
 
 1. Metrics stream summary (default).  The trainer's ``metrics_file`` is
    self-describing (every record carries a ``record`` type: run_header |
-   train | validation | heartbeat | final):
+   train | validation | heartbeat | alert | compile | final):
 
      python tools/report.py /path/to/metrics.jsonl
      python tools/report.py rank0.jsonl rank1.jsonl ...  # fleet merge
@@ -104,6 +104,7 @@ def _print_header(header: dict) -> None:
         "fast_ingest", "cache_epochs", "cache_prestacked", "ring_slots",
         "batch_size", "epoch_num",
         "optimizer", "backend", "jax_version", "mesh", "telemetry",
+        "resource_metrics",
         "heartbeat_secs", "resume_step", "resume_epoch", "resume_skip",
     ):
         if key in header:
@@ -172,6 +173,35 @@ def _print_breakdown(rec: dict) -> None:
     if rec.get("trace_dropped_events"):
         print(f"\n  !! trace TRUNCATED: {rec['trace_dropped_events']} "
               "event(s) dropped at the buffer cap — chains stop mid-run")
+    resource = rec.get("resource")
+    if resource:
+        print("\nmemory & compile (resource block):")
+        for key in ("rss_mb", "peak_rss_mb", "device_bytes_in_use",
+                    "device_peak_bytes", "device_bytes_est"):
+            if key in resource:
+                print(f"  {key:22s} {resource[key]}")
+        comps = [
+            (k, resource[k]) for k in (
+                "ring_bytes", "staging_bytes", "cache_bytes",
+                "cold_store_bytes", "trace_buffer_bytes",
+            ) if resource.get(k)
+        ]
+        if comps:
+            print("  component host-memory ledger:")
+            for name, v in comps:
+                print(f"    {name:20s} {v / (1 << 20):10.1f} MiB")
+        for key in ("compiles", "compile_s", "recompiles_unexpected",
+                    "flops_per_dispatch", "bytes_per_dispatch",
+                    "arithmetic_intensity", "model_flops_per_s"):
+            if key in resource:
+                print(f"  {key:22s} {resource[key]}")
+        if resource.get("recompiles_unexpected"):
+            print("  !! UNEXPECTED recompile(s) mid-run — the input "
+                  "stream changed shape under the trainer (only the "
+                  "epoch-tail K' compile is whitelisted)")
+    else:
+        print("\nmemory & compile: n/a (stream has no resource block — "
+              "pre-resource run or resource_metrics=off)")
     tiered = rec.get("tiered") or {}
     if tiered:
         print("\ntiered embedding table (hot/cold migration):")
@@ -222,6 +252,24 @@ def _print_breakdown(rec: dict) -> None:
                 f"  {name:24} {d['count']:>8} {d.get('mean', 0):>6} "
                 f"{d.get('max', 0):>5}  {buckets}"
             )
+
+
+def _print_compiles(compiles: list) -> None:
+    """Compile-sentinel stream summary: every `record: compile` entry is
+    one actual train-step compilation (wall time + XLA cost captured at
+    compile time); an unexpected one is the headline."""
+    if not compiles:
+        return
+    total_s = sum(c.get("compile_s", 0.0) for c in compiles)
+    bad = [c for c in compiles if not c.get("expected", True)]
+    print(f"\ncompiles ({len(compiles)}, {total_s:.2f}s total"
+          + (f", {len(bad)} UNEXPECTED" if bad else "") + "):")
+    for c in compiles:
+        flag = "" if c.get("expected", True) else "  << UNEXPECTED"
+        flops = c.get("flops")
+        extra = f"  {flops:.3g} flops" if flops else ""
+        print(f"  step {c.get('step', '?'):>6}  k={c.get('k', '?'):<4} "
+              f"{c.get('compile_s', 0.0):7.2f}s{extra}{flag}")
 
 
 def _print_alerts(alerts: list, limit: int = 8) -> None:
@@ -702,6 +750,19 @@ _DIRECTION_OVERRIDES = {
     "status_endpoint_overhead": "low",
     "trace_windows": None,
     "alerts_total": "low", "alerts_halt": "low",
+    # Resource plane (PR 8): memory footprints and compile costs
+    # regress when they RISE; sustained device FLOP/s regresses when
+    # it FALLS; the resource_overhead probe is a cost ratio like the
+    # telemetry/trace/status ones.  Bare spellings gate bench JSONs,
+    # `resource.`-prefixed ones the flattened metrics-stream block.
+    "peak_rss_mb": "low", "resource.peak_rss_mb": "low",
+    "rss_mb": None, "resource.rss_mb": None,
+    "compile_s": "low", "resource.compile_s": "low",
+    "recompiles_unexpected": "low",
+    "resource.recompiles_unexpected": "low",
+    "model_flops_per_s": "high", "resource.model_flops_per_s": "high",
+    "resource.compiles": None,
+    "resource_overhead": "low",
 }
 
 
@@ -756,6 +817,15 @@ def _comparable_metrics(path: str) -> dict:
         val = (final.get("tiered") or {}).get(key)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             out[f"tiered.{key}"] = float(val)
+    # Resource block (PR 8): gate the memory/compile axes.  Streams
+    # WITHOUT the block (pre-resource runs, resource_metrics=off)
+    # simply contribute no resource.* keys — --compare works on the
+    # shared set, so old baselines never KeyError.
+    for key in ("peak_rss_mb", "rss_mb", "compile_s", "compiles",
+                "recompiles_unexpected", "model_flops_per_s"):
+        val = (final.get("resource") or {}).get(key)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[f"resource.{key}"] = float(val)
     if "trace_dropped_events" in final:
         out["trace_dropped_events"] = float(final["trace_dropped_events"])
     # Watchdog output: total fires, halts, and per-rule counts — all
@@ -917,6 +987,7 @@ def main(argv=None) -> int:
         groups.get("train", []), groups.get("validation", []), args.limit
     )
     _print_alerts(groups.get("alert", []), args.limit)
+    _print_compiles(groups.get("compile", []))
     # The final record is the exact end-of-run report; fall back to the
     # last heartbeat for a run that died mid-flight (that's the point of
     # heartbeats: the stream still says where the time went).

@@ -29,7 +29,9 @@ fi
 echo
 echo "== live observability smoke (tools/obs_smoke.py) =="
 # A real CLI run with --status_port: /metrics must serve parseable
-# Prometheus text and /status the heartbeat JSON, mid-run.
+# Prometheus text (incl. the resource block + tffm_build_info) and
+# /status the heartbeat JSON, mid-run; /debug/threadz must dump every
+# thread; /profile must capture once and 409 a concurrent request.
 JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 
 echo
