@@ -691,6 +691,155 @@ def _bench_tiered(workers: int) -> dict:
     return out
 
 
+def _bench_serve(workers: int) -> dict:
+    """Serving section: latency under concurrent load through the FULL
+    online path — HTTP socket -> request batcher -> compiled
+    fixed-shape scorer — the numbers a million-user deployment is
+    sized from.
+
+    Client threads fire mixed-size scoring requests (1..64 examples,
+    Zipf-ish small-heavy, the online-traffic shape) flat-out for a
+    fixed window; latency is measured CLIENT-side (connect to last
+    byte, the number a user actually sees), throughput as completed
+    requests/s.  ``serve_batch_fill`` and the compile accounting come
+    from the server's own telemetry — ``serve_steady_compiles`` MUST
+    be 0 (every shape precompiled at warmup; a nonzero value here is
+    the latency cliff the ladder exists to prevent).
+    """
+    import threading as _th
+    import urllib.request as _rq
+
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.models import fm as _fm
+    from fast_tffm_tpu.serve.batcher import ServeBatcher
+    from fast_tffm_tpu.serve.scorer import FixedShapeScorer
+    from fast_tffm_tpu.serve.server import ServeServer
+    from fast_tffm_tpu import obs as _obs
+
+    import jax as _jax
+
+    out: dict = {"completed": False}
+    server = batcher = None
+    try:
+        cfg = FmConfig(
+            vocabulary_size=1 << 20, factor_num=8, max_features=39,
+            batch_size=1024, model_file="/tmp/fast_tffm_serve_bench",
+        )
+        params = _jax.jit(
+            lambda k: _fm.init_params(k, cfg=cfg)
+        )(_jax.random.PRNGKey(3))
+        tel = _obs.Telemetry()
+        scorer = FixedShapeScorer(cfg, params, telemetry=tel)
+        warm_compiles = scorer.warmup()
+        batcher = ServeBatcher(
+            scorer, max_batch_wait_ms=cfg.max_batch_wait_ms,
+            queue_size=cfg.queue_size, telemetry=tel,
+        )
+        server = ServeServer(
+            0, batcher, cfg,
+            lambda: {"record": "status", "stages": tel.snapshot()},
+            telemetry=tel,
+        )
+        rng = np.random.default_rng(5)
+        # Pre-render request bodies (mixed sizes, small-request-heavy)
+        # so client threads measure the SERVER, not body formatting.
+        sizes = [1, 1, 2, 4, 4, 8, 16, 32, 64]
+        bodies = []
+        for n in sizes * 4:
+            lines = []
+            for _ in range(n):
+                ids = rng.integers(0, cfg.vocabulary_size, 12)
+                lines.append("0 " + " ".join(
+                    f"{i}:{rng.uniform(0.1, 1.0):.3f}" for i in ids
+                ))
+            bodies.append(("\n".join(lines) + "\n").encode())
+        url = f"http://127.0.0.1:{server.port}/score"
+        duration = 4.0
+        n_clients = min(8, max(2, workers))
+        lat_lock = _th.Lock()
+        lats: list = []
+        errors: list = []
+
+        def client(seed: int):
+            r = np.random.default_rng(seed)
+            end = time.perf_counter() + duration
+            my = []
+            try:
+                while time.perf_counter() < end:
+                    body = bodies[int(r.integers(0, len(bodies)))]
+                    t0 = time.perf_counter()
+                    try:
+                        resp = _rq.urlopen(_rq.Request(
+                            url, data=body, method="POST"
+                        ), timeout=30)
+                        resp.read()
+                    except Exception as e:  # noqa: BLE001 - report below
+                        errors.append(f"{type(e).__name__}: {e}")
+                        return
+                    my.append(time.perf_counter() - t0)
+            finally:
+                # A client dying mid-window still contributes the work
+                # it DID complete — qps/percentiles must not silently
+                # drop a whole client's samples over one late error.
+                with lat_lock:
+                    lats.extend(my)
+
+        # Warm the HTTP+dispatch path once so client 0's first request
+        # doesn't measure connection/jit-cache cold start.
+        _rq.urlopen(_rq.Request(url, data=bodies[0], method="POST"),
+                    timeout=60).read()
+        threads = [
+            _th.Thread(target=client, args=(100 + i,))
+            for i in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if not lats:
+            out["error"] = "no request completed: " + "; ".join(
+                errors[:3]
+            )
+            return out
+        arr = np.array(lats) * 1e3
+        snap = tel.snapshot()
+        counters = snap.get("counters", {})
+        out.update({
+            "completed": True,
+            "clients": n_clients,
+            "duration_s": round(wall, 2),
+            "requests": len(lats),
+            "serve_qps": round(len(lats) / wall, 1),
+            "serve_examples_per_sec": round(
+                counters.get("serve.examples", 0) / wall, 1
+            ),
+            "serve_p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "serve_p95_ms": round(float(np.percentile(arr, 95)), 3),
+            "serve_p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "serve_batch_fill": round(batcher.batch_fill, 4),
+            "serve_batches": int(counters.get("serve.batches", 0)),
+            "warmup_compiles": warm_compiles,
+            "serve_steady_compiles": int(scorer.steady_compiles),
+            "max_batch_wait_ms": cfg.max_batch_wait_ms,
+        })
+        if errors:
+            out["client_errors"] = errors[:5]
+    except Exception as e:  # noqa: BLE001 - report, never sink the bench
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        # A failed probe must not leak the serve stack (HTTP thread,
+        # dispatcher thread, the device-resident scorer) into the
+        # sections that run after it — exactly the cross-section
+        # contamination this section was reordered to avoid.
+        if server is not None:
+            server.close()
+        if batcher is not None:
+            batcher.close()
+    return out
+
+
 def _bench_pipeline_ingest(files, cfg, parse_processes: int
                            ) -> tuple[float, float]:
     """(lines/sec, ring_zero_copy_frac) draining the FULL BatchPipeline
@@ -778,6 +927,7 @@ def main() -> int:
     step_rate_k1, e2e_rate_k1 = 0.0, 0.0
     s_samples, s1_samples, e_samples = [], [], []
     tiered_section = None
+    serve_section = None
     dispatch_overhead_ms, h2d_overlap_frac = 0.0, 0.0
     e2e_epoch0, e2e_cached = 0.0, 0.0
     ingest_threads_rate, ingest_procs_rate = 0.0, 0.0
@@ -1047,10 +1197,17 @@ def main() -> int:
             bf16_errors = [f"bf16 bench: {type(e).__name__}: {e}"]
 
         if args.mode == "e2e":
+            del trainer
+            # Serving section: latency under concurrent load through
+            # the HTTP -> batcher -> compiled-ladder path (SERVING.md).
+            # Runs BEFORE the tiered section: the V=2^28 cold stores
+            # leave ~7 GB of process RSS behind, and serving latency
+            # measured under that allocator pressure read ~10x worse
+            # than the same probe on a clean process.
+            serve_section = _bench_serve(workers)
             # Tiered-table section: the V=2^28 run a dense device table
             # cannot hold, plus its dense V=2^26 overlap baseline.  Its
             # own trainers/files; isolated from the judged numbers above.
-            del trainer
             tiered_section = _bench_tiered(workers)
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         e2e_err = f"bench failed: {type(e).__name__}: {e}"
@@ -1178,6 +1335,17 @@ def main() -> int:
         result["telemetry"] = tele_report
     if tiered_section is not None:
         result["tiered_table"] = tiered_section
+    if serve_section is not None:
+        result["serve"] = serve_section
+        if serve_section.get("completed"):
+            # Top-level copies of the gated axes: --compare only
+            # flattens numeric TOP-LEVEL bench keys (serve_p99_ms low,
+            # serve_qps/serve_batch_fill high, serve_steady_compiles
+            # low — a nonzero steady compile is the latency cliff).
+            for key in ("serve_p50_ms", "serve_p95_ms", "serve_p99_ms",
+                        "serve_qps", "serve_batch_fill",
+                        "serve_steady_compiles"):
+                result[key] = serve_section[key]
     if tier1_audit is not None:
         result["tier1_audit"] = tier1_audit
     if ladder_rung is not None:

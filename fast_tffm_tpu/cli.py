@@ -1,4 +1,5 @@
-"""CLI: ``run_tffm.py {train|predict} <cfg>`` (reference surface, SURVEY.md §2 #12).
+"""CLI: ``run_tffm.py {train|predict|serve} <cfg>`` (reference surface,
+SURVEY.md §2 #12, plus the online-serving mode).
 
 Local mode mirrors the reference exactly.  Distributed mode replaces the
 parameter-server flags with a JAX multi-host launch: every process runs the
@@ -38,7 +39,7 @@ def build_argparser() -> argparse.ArgumentParser:
         prog="run_tffm",
         description="TPU-native factorization machine trainer",
     )
-    p.add_argument("mode", choices=["train", "predict"])
+    p.add_argument("mode", choices=["train", "predict", "serve"])
     p.add_argument("cfg", help="INI config file (reference-compatible)")
     # TPU-native distributed flags.
     p.add_argument("--coordinator", default=None,
@@ -155,6 +156,37 @@ def build_argparser() -> argparse.ArgumentParser:
              "... every N events (removes the in-memory cap for long "
              "traced runs; merge with tools/report.py --trace)",
     )
+    # Online-serving knobs (serve mode; override the cfg file).
+    p.add_argument(
+        "--serve_port", type=int, default=None,
+        help="serve mode: HTTP scoring endpoint port (POST /score + "
+             "/metrics /status /healthz; 0 = OS-assigned, printed at "
+             "startup)",
+    )
+    p.add_argument(
+        "--serve_host", default=None, metavar="ADDR",
+        help="bind address for --serve_port (default 127.0.0.1; the "
+             "endpoint is unauthenticated, 0.0.0.0 is an explicit "
+             "opt-in)",
+    )
+    p.add_argument(
+        "--serve_batch_sizes", default=None, metavar="N,N,...",
+        help="fixed microbatch shape ladder (example counts) requests "
+             "pad/coalesce into; every rung precompiles at startup so "
+             "steady-state serving never compiles",
+    )
+    p.add_argument(
+        "--max_batch_wait_ms", type=float, default=None,
+        help="request-coalescing deadline: dispatch a microbatch when "
+             "the largest rung fills or this many ms pass (0 = "
+             "dispatch immediately)",
+    )
+    p.add_argument(
+        "--serve_poll_secs", type=float, default=None,
+        help="poll the trainer-published checkpoint manifest every N "
+             "seconds and hot-swap new params with zero recompiles "
+             "(0 = serve the startup checkpoint forever)",
+    )
     # Legacy reference flags (mapped, SURVEY.md §3.2).
     p.add_argument("--ps_hosts", default=None, help="legacy; ps tasks exit")
     p.add_argument("--worker_hosts", default=None,
@@ -204,7 +236,9 @@ def main(argv=None) -> int:
                     "cache_prestacked", "ring_slots", "heartbeat_secs",
                     "trace_file", "nan_policy", "table_tiering", "hot_rows",
                     "status_port", "status_host", "alert_rules",
-                    "trace_rotate_events")
+                    "trace_rotate_events", "serve_port", "serve_host",
+                    "serve_batch_sizes", "max_batch_wait_ms",
+                    "serve_poll_secs")
         if getattr(args, key) is not None
     }
     if args.no_telemetry:
@@ -218,6 +252,11 @@ def main(argv=None) -> int:
         from fast_tffm_tpu.train import dist as dist_lib
 
         dist_lib.initialize(*dist)
+
+    if args.mode == "serve":
+        from fast_tffm_tpu.serve.server import serve_forever
+
+        return serve_forever(cfg)
 
     from fast_tffm_tpu.train.loop import Trainer, predict
 

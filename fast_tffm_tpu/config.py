@@ -124,6 +124,30 @@ class FmConfig:
     # --- [Predict] ---
     predict_files: list[str] = dataclasses.field(default_factory=list)
     score_path: str = "./scores.txt"
+    # Online serving (run_tffm.py serve; fast_tffm_tpu/serve): an HTTP
+    # scoring endpoint (POST /score, libsvm lines in, one score per
+    # line out) over a compiled fixed-shape scorer.  0 with the serve
+    # mode = an OS-assigned port (logged, and printed as
+    # "serving on host:port").
+    serve_port: int = 0
+    # Bind address for the scoring endpoint.  Loopback by default for
+    # the same reason as status_host: the endpoint is unauthenticated.
+    serve_host: str = "127.0.0.1"
+    # The fixed microbatch shape ladder: requests pad/coalesce into the
+    # smallest of these example counts that holds them, and every rung
+    # is AOT-precompiled at startup — steady-state serving never
+    # compiles.  Comma-separated, ascending after parse.
+    serve_batch_sizes: str = "64,256,1024"
+    # Request-coalescing deadline: a microbatch dispatches when the
+    # largest rung fills OR this many ms pass since its first request —
+    # the latency/throughput dial.  0 = dispatch immediately (lowest
+    # latency, worst fill).
+    max_batch_wait_ms: float = 2.0
+    # Warm checkpoint hot-swap: poll the trainer-published
+    # serve_manifest.json every this-many seconds and swap new params
+    # in between dispatches (zero recompiles, no dropped requests).
+    # 0 = serve the startup checkpoint forever.
+    serve_poll_secs: float = 2.0
 
     # --- observability (SURVEY.md §5: tracing/metrics rebuild) ---
     # Directory for a jax.profiler trace of steps
@@ -379,6 +403,20 @@ class FmConfig:
                         "these rules could never fire; enable "
                         "resource_metrics or drop the rules"
                     )
+        if not 0 <= self.serve_port < 65536:
+            raise ValueError(
+                f"serve_port must be in [0, 65535], got {self.serve_port}"
+            )
+        if self.max_batch_wait_ms < 0:
+            raise ValueError(
+                "max_batch_wait_ms must be >= 0, got "
+                f"{self.max_batch_wait_ms}"
+            )
+        if self.serve_poll_secs < 0:
+            raise ValueError(
+                f"serve_poll_secs must be >= 0, got {self.serve_poll_secs}"
+            )
+        self.serve_ladder  # parse/validate serve_batch_sizes at startup
         if self.cache_max_bytes <= 0:
             raise ValueError(
                 f"cache_max_bytes must be positive, got {self.cache_max_bytes}"
@@ -403,6 +441,27 @@ class FmConfig:
                 "weight_files must parallel train_files "
                 f"({len(self.weight_files)} vs {len(self.train_files)})"
             )
+
+    @property
+    def serve_ladder(self) -> tuple:
+        """``serve_batch_sizes`` parsed into an ascending tuple of
+        unique positive ints (the serving microbatch shape ladder)."""
+        try:
+            sizes = tuple(sorted({
+                int(p) for p in self.serve_batch_sizes.split(",")
+                if p.strip()
+            }))
+        except ValueError:
+            raise ValueError(
+                "serve_batch_sizes must be comma-separated ints, got "
+                f"{self.serve_batch_sizes!r}"
+            ) from None
+        if not sizes or any(s <= 0 for s in sizes):
+            raise ValueError(
+                "serve_batch_sizes needs at least one positive size, "
+                f"got {self.serve_batch_sizes!r}"
+            )
+        return sizes
 
     @property
     def embedding_dim(self) -> int:
@@ -466,6 +525,11 @@ _KEYMAP = {
     "seed": ("seed", int),
     "predict_files": ("predict_files", _parse_files),
     "score_path": ("score_path", str),
+    "serve_port": ("serve_port", int),
+    "serve_host": ("serve_host", str),
+    "serve_batch_sizes": ("serve_batch_sizes", str),
+    "max_batch_wait_ms": ("max_batch_wait_ms", float),
+    "serve_poll_secs": ("serve_poll_secs", float),
     "profile_dir": ("profile_dir", str),
     "profile_start_step": ("profile_start_step", int),
     "profile_steps": ("profile_steps", int),

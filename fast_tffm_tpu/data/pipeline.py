@@ -46,6 +46,7 @@ _CHUNK_BYTES = 4 << 20
 
 _SENTINEL = object()
 _CANCELLED = object()
+_TIMEOUT = object()  # _ClosableQueue.get(timeout=...) expired empty
 
 
 class EpochEnd(NamedTuple):
@@ -115,10 +116,24 @@ class _ClosableQueue:
             self._cv.notify_all()
             return True
 
-    def get(self):
+    def get(self, timeout: Optional[float] = None):
+        """Next item; blocks until one arrives, the queue is cancelled
+        (``_CANCELLED``), or — with ``timeout`` — the deadline passes
+        with the queue still empty (``_TIMEOUT``).  The timed form is
+        the serve batcher's coalescing wait: collect requests until the
+        microbatch deadline, then dispatch whatever arrived."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
         with self._cv:
             while not self._items and not self._cancelled:
-                self._cv.wait()
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return _TIMEOUT
+                self._cv.wait(remaining)
             if not self._items:
                 return _CANCELLED
             self._hist.observe(len(self._items))

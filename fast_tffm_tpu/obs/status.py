@@ -52,7 +52,9 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-__all__ = ["StatusServer", "render_prometheus", "thread_dump"]
+__all__ = [
+    "QuietHandler", "StatusServer", "render_prometheus", "thread_dump",
+]
 
 log = logging.getLogger(__name__)
 
@@ -112,9 +114,9 @@ def render_prometheus(record: dict) -> str:
     - ``stages.counters`` -> ``tffm_counter_<name>_total`` counters;
     - ``stages.gauges`` -> ``tffm_gauge_<name>`` gauges;
     - ``stages.timers`` -> ``tffm_timer_<name>_count`` /
-      ``_seconds_total`` counters + ``_p50_ms``/``_p95_ms``/``_max_ms``
-      /``_mean_ms`` gauges (the percentiles describe the recent ring —
-      see telemetry.Timing);
+      ``_seconds_total`` counters + ``_p50_ms``/``_p95_ms``/``_p99_ms``
+      /``_max_ms``/``_mean_ms`` gauges (the percentiles describe the
+      recent ring — see telemetry.Timing);
     - ``stages.depths`` -> ``tffm_depth_<name>_events_total`` /
       ``_mean`` / ``_max`` plus per-band ``_bucket{band="1-3"}`` gauges
       (occupancy bands, not cumulative ``le`` buckets);
@@ -122,6 +124,9 @@ def render_prometheus(record: dict) -> str:
     - ``tiered.*`` -> ``tffm_tiered_<key>`` gauges;
     - ``resource.*`` -> ``tffm_resource_<key>`` gauges (RSS, component
       byte ledger, compile counters, FLOPs attribution);
+    - ``serve.*`` -> ``tffm_serve_<key>`` gauges (qps, latency
+      percentiles, batch fill, steady_compiles — the serving
+      endpoint's record block);
     - ``build_info`` (a dict of strings) -> one ``tffm_build_info``
       info-style gauge whose LABELS carry the run identity (jax
       version, backend, mesh, K), value always 1 — the Prometheus
@@ -151,7 +156,7 @@ def render_prometheus(record: dict) -> str:
         base = f"tffm_timer_{_prom_name(name)}"
         emit(f"{base}_count", snap.get("count", 0), "counter")
         emit(f"{base}_seconds_total", snap.get("total_s", 0.0), "counter")
-        for pkey in ("mean_ms", "p50_ms", "p95_ms", "max_ms"):
+        for pkey in ("mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
             if pkey in snap:
                 emit(f"{base}_{pkey}", snap[pkey])
     for name, snap in sorted((stages.get("depths") or {}).items()):
@@ -166,7 +171,7 @@ def render_prometheus(record: dict) -> str:
             lines.append(f"# TYPE {base}_bucket gauge")
             for band, n in buckets.items():
                 lines.append(f'{base}_bucket{{band="{band}"}} {n}')
-    for block in ("health", "tiered", "resource"):
+    for block in ("health", "tiered", "resource", "serve"):
         for key, val in sorted((record.get(block) or {}).items()):
             emit(f"tffm_{block}_{_prom_name(key)}", val)
     info = record.get("build_info")
@@ -180,6 +185,70 @@ def render_prometheus(record: dict) -> str:
         lines.append("# TYPE tffm_build_info gauge")
         lines.append(f"tffm_build_info{{{labels}}} 1")
     return "\n".join(lines) + "\n"
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    """Shared handler base for the in-process endpoints (this status
+    server and the serving endpoint): silenced access log, the one
+    response helper, and the common observability GET routes — so the
+    surface both endpoints promise lives in one place."""
+
+    # Keep-alive: every response carries Content-Length (see _send), so
+    # HTTP/1.1 is safe and spares latency-critical clients a TCP
+    # connect + handler-thread spawn per request.
+    protocol_version = "HTTP/1.1"
+    # Socket timeout: a peer that stalls mid-read (short body behind a
+    # larger Content-Length, half-open connection) must release the
+    # handler thread instead of pinning it forever.
+    timeout = 60
+
+    def log_message(self, *args) -> None:  # quiet access log
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        if code >= 400:
+            # Error paths may not have consumed the request body; a
+            # kept-alive connection would misparse the leftover bytes
+            # as the next request.
+            self.close_connection = True
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _get_observability(self, path: str, build) -> bool:
+        """Answer the shared routes (``/healthz``, ``/debug/threadz``,
+        ``/metrics``, ``/status``); returns False for anything else so
+        the subclass can dispatch its own.  ``build`` is the owner's
+        on-demand record builder; its failures degrade to 500 — an
+        observability endpoint reports errors, it never dies of them."""
+        if path == "/healthz":
+            self._send(200, b"ok\n", "text/plain")
+            return True
+        if path == "/debug/threadz":
+            self._send(200, thread_dump().encode(), "text/plain")
+            return True
+        if path not in ("/metrics", "/status"):
+            return False
+        try:
+            record = build() or {}
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            self._send(
+                500, f"builder failed: {e}\n".encode(), "text/plain"
+            )
+            return True
+        if path == "/status":
+            self._send(
+                200, (json.dumps(record) + "\n").encode(),
+                "application/json",
+            )
+        else:
+            self._send(
+                200, render_prometheus(record).encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        return True
 
 
 class StatusServer:
@@ -213,50 +282,17 @@ class StatusServer:
         )
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args) -> None:  # quiet access log
-                pass
-
-            def _send(self, code: int, body: bytes, ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(QuietHandler):
             def do_GET(self) -> None:  # noqa: N802 - http.server API
                 if server._requests is not None:
                     server._requests.add()
                 path, _, query = self.path.partition("?")
-                if path == "/healthz":
-                    self._send(200, b"ok\n", "text/plain")
-                    return
-                if path == "/debug/threadz":
-                    self._send(200, thread_dump().encode(), "text/plain")
+                if self._get_observability(path, server._build):
                     return
                 if path == "/profile":
                     self._do_profile(query)
                     return
-                if path not in ("/metrics", "/status"):
-                    self._send(404, b"not found\n", "text/plain")
-                    return
-                try:
-                    record = server._build() or {}
-                except Exception as e:  # noqa: BLE001 - report, don't die
-                    self._send(
-                        500, f"builder failed: {e}\n".encode(),
-                        "text/plain",
-                    )
-                    return
-                if path == "/status":
-                    body = (json.dumps(record) + "\n").encode()
-                    self._send(200, body, "application/json")
-                else:
-                    body = render_prometheus(record).encode()
-                    self._send(
-                        200, body,
-                        "text/plain; version=0.0.4; charset=utf-8",
-                    )
+                self._send(404, b"not found\n", "text/plain")
 
             def _do_profile(self, query: str) -> None:
                 """On-demand profiler window.  Blocks THIS handler

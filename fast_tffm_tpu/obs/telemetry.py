@@ -11,7 +11,7 @@ into.  This module is that registry:
 - :class:`Gauge` — last-value instruments, plus snapshot-time *samples*
   (callables evaluated when a snapshot is taken: queue depths);
 - :class:`Timing` — a lock-guarded ring of recent durations with
-  monotonic count/total, reporting p50/p95/max over the window (the
+  monotonic count/total, reporting p50/p95/p99/max over the window (the
   fixed ring bounds memory for million-step runs; totals stay exact);
 - :class:`DepthHist` — a per-event queue-depth histogram over
   power-of-two buckets.  Point-sampled depth gauges only see the queue
@@ -149,17 +149,20 @@ class Timing:
             count, total = self._count, self._total
         if not count:
             return {"count": 0, "total_s": 0.0}
-        # p50/p95/max all describe the recent window (a cold-start
+        # p50/p95/p99/max all describe the recent window (a cold-start
         # outlier ages out of max_ms once the ring turns over);
-        # count/total_s are run-exact.
+        # count/total_s are run-exact.  p99 exists for the serving path
+        # (tail latency is the SLO number) but every timer reports it.
         p50 = window[int(0.50 * (n - 1))] if n else 0.0
         p95 = window[int(0.95 * (n - 1))] if n else 0.0
+        p99 = window[int(0.99 * (n - 1))] if n else 0.0
         return {
             "count": count,
             "total_s": round(total, 6),
             "mean_ms": round(1e3 * total / count, 4),
             "p50_ms": round(1e3 * p50, 4),
             "p95_ms": round(1e3 * p95, 4),
+            "p99_ms": round(1e3 * p99, 4),
             "max_ms": round(1e3 * window[-1], 4) if n else 0.0,
         }
 

@@ -1,0 +1,24 @@
+"""Online serving path: compiled fixed-shape scoring + request batching.
+
+``serve.scorer`` owns the compiled scoring functions — a small ladder of
+fixed microbatch shapes, precompiled through an AOT ``.lower().compile()``
+cache with donated input buffers, so steady-state serving never compiles
+(``FixedShapeScorer`` for dense checkpoints, ``OverlayScorer`` for
+huge-V ``tiered.npz`` sparse overlays).  ``serve.batcher`` coalesces
+concurrent requests into microbatches under a ``max_batch_wait_ms``
+deadline.  ``serve.server`` mounts the whole thing behind a stdlib HTTP
+endpoint (``POST /score`` + the same ``/metrics``/``/status`` surface as
+``obs/status.py``) with warm checkpoint hot-swap driven by the trainer's
+save-path manifest.  See SERVING.md for the dataflow.
+"""
+
+from fast_tffm_tpu.serve.batcher import ServeBatcher
+from fast_tffm_tpu.serve.scorer import (
+    FixedShapeScorer, OverlayScorer, load_model, make_scorer,
+)
+from fast_tffm_tpu.serve.server import ServeHandle, serve, serve_forever
+
+__all__ = [
+    "FixedShapeScorer", "OverlayScorer", "ServeBatcher", "ServeHandle",
+    "load_model", "make_scorer", "serve", "serve_forever",
+]

@@ -1,0 +1,258 @@
+"""Request-batching front end: coalesce concurrent requests into
+fixed-shape microbatches.
+
+One scoring dispatch amortizes over every example in it, so serving
+throughput lives or dies on batch fill — but a request must not wait
+forever for company.  :class:`ServeBatcher` is the standard tradeoff
+dial: concurrent requests land in a bounded queue (the ingest layer's
+``_ClosableQueue``, depth-histogrammed as ``serve.queue_depth``), and a
+single dispatcher thread coalesces them into one microbatch until
+either the largest ladder rung fills or ``max_batch_wait_ms`` expires
+— whichever comes first.  An idle server costs a lone request at most
+the deadline; a saturated server fills rungs and the deadline never
+fires.
+
+The dispatcher fills its own recycled per-rung staging buffers
+directly (one row copy per example, no per-request concatenation —
+the prefetcher's staging-pool discipline applied to requests), runs
+ONE scorer dispatch, then splits the scores back per request and
+releases the waiting client threads.  Because dispatches are serial
+and the scorer resolves its model reference once per dispatch, a hot
+swap can never interleave old and new params inside one microbatch.
+
+Instruments (all ``serve.*``, documented in OBSERVABILITY.md):
+``requests`` / ``examples`` / ``batches`` counters, the ``latency``
+timer (enqueue -> scores delivered; p50/p95/p99 ride every snapshot),
+the ``batch_fill`` gauge (cumulative filled/dispatched slots), and the
+``queue_depth`` histogram.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.data.pipeline import (
+    _CANCELLED, _TIMEOUT, _ClosableQueue,
+)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ScoreRequest", "ServeBatcher"]
+
+
+class ScoreRequest:
+    """One in-flight scoring request (a future the client waits on)."""
+
+    __slots__ = ("ids", "vals", "fields", "n", "event", "scores",
+                 "error", "t0")
+
+    def __init__(self, ids, vals, fields):
+        self.ids = ids
+        self.vals = vals
+        self.fields = fields
+        self.n = len(ids)
+        self.event = threading.Event()
+        self.scores: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.t0 = time.perf_counter()
+
+
+class ServeBatcher:
+    """Coalesce requests into microbatches under a latency deadline."""
+
+    def __init__(self, scorer, max_batch_wait_ms: float = 2.0,
+                 queue_size: int = 1024, telemetry=None):
+        self._scorer = scorer
+        self._wait_s = max(0.0, float(max_batch_wait_ms)) / 1e3
+        tel = telemetry if telemetry is not None else obs.NULL
+        self._c_requests = tel.counter("serve.requests")
+        self._c_examples = tel.counter("serve.examples")
+        self._c_batches = tel.counter("serve.batches")
+        self._t_latency = tel.timer("serve.latency")
+        self._g_fill = tel.gauge("serve.batch_fill")
+        self._q = _ClosableQueue(
+            queue_size, hist=tel.depth_hist("serve.queue_depth")
+        )
+        # The batcher's OWN recycled per-rung staging buffers.  It must
+        # not borrow the scorer's pools: those are guarded by the
+        # scorer's dispatch lock, and the dispatcher fills buffers
+        # BEFORE taking that lock — sharing them would let a direct
+        # scorer.score() caller race the fill.
+        self._pools: dict = {}
+        # Fill accounting (dispatcher thread only): real examples vs
+        # padded slots over every dispatched rung.
+        self._slots = 0
+        self._filled = 0
+        # Outstanding requests, so close() can fail the ones a queue
+        # cancel() discards instead of leaving clients blocked forever.
+        self._outstanding: set = set()
+        self._out_lock = threading.Lock()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="tffm-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, ids, vals, fields=None) -> ScoreRequest:
+        """Enqueue ``[n, max_features]`` arrays; returns the request
+        future.  Raises RuntimeError once the batcher is closed."""
+        req = ScoreRequest(
+            np.ascontiguousarray(ids, np.int32),
+            np.ascontiguousarray(vals, np.float32),
+            (np.ascontiguousarray(fields, np.int32)
+             if fields is not None else None),
+        )
+        with self._out_lock:
+            if self._closed:
+                raise RuntimeError("ServeBatcher is closed")
+            self._outstanding.add(req)
+        if not self._q.put(req):
+            with self._out_lock:
+                self._outstanding.discard(req)
+            raise RuntimeError("ServeBatcher is closed")
+        self._c_requests.add()
+        return req
+
+    def result(self, req: ScoreRequest,
+               timeout: float = 30.0) -> np.ndarray:
+        """Block until the request's scores arrive (or raise)."""
+        if not req.event.wait(timeout):
+            raise TimeoutError(
+                f"scoring request ({req.n} examples) timed out after "
+                f"{timeout}s"
+            )
+        if req.error is not None:
+            raise req.error
+        return req.scores
+
+    def score(self, ids, vals, fields=None,
+              timeout: float = 30.0) -> np.ndarray:
+        """submit + result in one call (the HTTP handler's path)."""
+        return self.result(self.submit(ids, vals, fields), timeout)
+
+    @property
+    def batch_fill(self) -> float:
+        return self._filled / self._slots if self._slots else 0.0
+
+    def _pool(self, b: int):
+        bufs = self._pools.get(b)
+        if bufs is None:
+            F = self._scorer.cfg.max_features
+            bufs = (
+                np.zeros((b, F), np.int32),
+                np.zeros((b, F), np.float32),
+                np.zeros((b, F), np.int32),
+            )
+            self._pools[b] = bufs
+        return bufs
+
+    # -- dispatcher thread ---------------------------------------------
+
+    def _run(self) -> None:
+        max_b = self._scorer.max_rung
+        pending: Optional[ScoreRequest] = None
+        while True:
+            first = pending if pending is not None else self._q.get()
+            pending = None
+            if first is _CANCELLED:
+                break
+            group = [first]
+            total = first.n
+            deadline = time.monotonic() + self._wait_s
+            while total < max_b:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                nxt = self._q.get(timeout=remaining)
+                if nxt is _TIMEOUT:
+                    break
+                if nxt is _CANCELLED:
+                    break
+                if total + nxt.n > max_b:
+                    # Doesn't fit this rung: dispatch what we have and
+                    # seed the next microbatch (keeps every coalesced
+                    # group within one dispatch).
+                    pending = nxt
+                    break
+                group.append(nxt)
+                total += nxt.n
+            self._dispatch(group, total)
+        # Queue cancelled: fail whatever is still outstanding (items
+        # the cancel discarded AND a pending carry-over).
+        self._fail_outstanding(RuntimeError("ServeBatcher closed"))
+
+    def _dispatch(self, group, total: int) -> None:
+        scorer = self._scorer
+        try:
+            if len(group) == 1 and total > scorer.max_rung:
+                # One oversized request: the scorer chunks it itself
+                # and owns the matching slot accounting.
+                req = group[0]
+                scores = scorer.score(req.ids, req.vals, req.fields)
+                self._slots += scorer.slots_for(total)
+            else:
+                b = scorer.rung_for(total)
+                bi, bv, bf = self._pool(b)
+                pos = 0
+                any_fields = any(g.fields is not None for g in group)
+                for g in group:
+                    bi[pos:pos + g.n] = g.ids
+                    bv[pos:pos + g.n] = g.vals
+                    if any_fields:
+                        bf[pos:pos + g.n] = (
+                            g.fields if g.fields is not None else 0
+                        )
+                    pos += g.n
+                if pos < b:
+                    bi[pos:] = 0
+                    bv[pos:] = 0.0
+                    if any_fields:
+                        bf[pos:] = 0
+                scores = scorer.score_rung(
+                    bi, bv, bf if any_fields else None, b
+                )
+                self._slots += b
+            self._filled += total
+            self._g_fill.set(round(self.batch_fill, 6))
+            self._c_batches.add()
+            self._c_examples.add(total)
+            now = time.perf_counter()
+            pos = 0
+            for g in group:
+                g.scores = np.asarray(scores[pos:pos + g.n], np.float32)
+                pos += g.n
+                self._t_latency.observe(now - g.t0)
+                with self._out_lock:
+                    self._outstanding.discard(g)
+                g.event.set()
+        except BaseException as e:  # noqa: BLE001 - fail the CLIENTS
+            log.warning("serve dispatch failed: %s", e)
+            for g in group:
+                g.error = e
+                with self._out_lock:
+                    self._outstanding.discard(g)
+                g.event.set()
+
+    def _fail_outstanding(self, exc: BaseException) -> None:
+        with self._out_lock:
+            stale = list(self._outstanding)
+            self._outstanding.clear()
+        for req in stale:
+            req.error = exc
+            req.event.set()
+
+    def close(self) -> None:
+        """Stop the dispatcher and fail any queued requests.
+        Idempotent."""
+        with self._out_lock:
+            self._closed = True
+        self._q.cancel()
+        self._thread.join()

@@ -1,0 +1,568 @@
+"""Compiled fixed-shape scorers: the serving path's device half.
+
+Online traffic arrives at arbitrary sizes; a jit that traces per
+request shape would recompile constantly (a multi-second stall per new
+shape) and XLA executables only exist at fixed shapes anyway.  The
+scorers here pin a small LADDER of microbatch shapes (``{64, 256,
+1024}`` examples x ``max_features`` by default, ``serve_batch_sizes``)
+and pad every request/chunk up to the smallest rung that holds it:
+
+- every rung is precompiled at startup through an AOT
+  ``.lower().compile()`` cache (:meth:`warmup`), so steady-state
+  serving NEVER compiles — the zero-compile contract the serving tests
+  pin (``steady_compiles``);
+- input buffers are donated (``donate_argnums``): XLA may reuse the
+  microbatch's device memory for the result, and the host side fills
+  recycled per-rung staging buffers (the prefetcher's staging-pool
+  discipline) instead of allocating per dispatch;
+- parameters are an ARGUMENT of the compiled function, not a constant —
+  so a warm checkpoint hot-swap is one reference swap between
+  dispatches (:meth:`swap`), zero recompiles, and a dispatch always
+  scores against exactly one table (old or new, never torn).
+
+Two variants share the plumbing: :class:`FixedShapeScorer` scores a
+dense device-resident table (the ordinary checkpoint format), and
+:class:`OverlayScorer` scores straight from a huge-V ``tiered.npz``
+sparse-overlay checkpoint — per chunk it remaps the batch's unique ids
+to a compact bucket-padded table gathered from the host cold store
+(the same compact-table trick the tiered trainer's validation path
+uses), so a V >= 2^28 model serves without ever materializing [V, D].
+
+Compile accounting mirrors the trainer's sentinel: every compile is
+timed into a ``serve.compile`` timer and written as a ``record:
+compile`` JSONL entry (``where: serve``); a compile at a shape OUTSIDE
+the ladder bumps ``serve.recompiles_unexpected`` and warns — on the
+serving path an unexpected compile is a multi-second latency cliff.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+import warnings
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.models import fm
+from fast_tffm_tpu.parallel import mesh as mesh_lib
+from fast_tffm_tpu.train import checkpoint
+from fast_tffm_tpu.train import tiered as tiered_lib
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "FixedShapeScorer", "OverlayScorer", "load_model", "make_scorer",
+]
+
+
+def _round_up(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """Silence the per-compile "donated buffers were not usable"
+    UserWarning: donation is a best-effort device-memory optimization
+    (it pays off where input/output buffers can alias, e.g. TPU); on
+    backends where it can't, one warning per ladder rung at startup is
+    pure noise."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message=".*donated buffers were not usable.*"
+        )
+        yield
+
+
+class _LadderScorer:
+    """Shared rung/pool/compile plumbing of the two scorer variants.
+
+    Thread contract: :meth:`score` / :meth:`score_rung` serialize on one
+    lock (the batcher dispatches from a single thread anyway; the lock
+    makes direct callers safe too).  :meth:`swap` may run on any thread:
+    it replaces the model REFERENCE under ``_swap_lock``, and a dispatch
+    grabs that reference once and uses it for the whole microbatch — so
+    every dispatch scores against exactly one model (old or new, never
+    torn) and a swap never waits on traffic.
+    """
+
+    def __init__(self, cfg: FmConfig, mesh=None, telemetry=None,
+                 writer=None, extra_rungs=()):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
+        data_n = int(self.mesh.shape[mesh_lib.DATA_AXIS])
+        rungs = sorted({
+            _round_up(int(b), data_n)
+            for b in tuple(cfg.serve_ladder) + tuple(extra_rungs)
+            if int(b) > 0
+        })
+        self.ladder = tuple(rungs)
+        self.max_rung = self.ladder[-1]
+        self._ladder_set = set(self.ladder)
+        tel = telemetry if telemetry is not None else obs.NULL
+        self._tel = tel
+        self._t_compile = tel.timer("serve.compile")
+        self._t_dispatch = tel.timer("serve.dispatch")
+        self._c_unexpected = tel.counter("serve.recompiles_unexpected")
+        self._c_swaps = tel.counter("serve.swaps")
+        self._writer = writer
+        self._lock = threading.Lock()  # serializes dispatch + pools
+        self._swap_lock = threading.Lock()
+        self._cache: dict = {}
+        self._pools: dict = {}  # rung -> (ids, vals, fields) host buffers
+        self._aot_broken = False
+        self._warmed = False
+        # Whether EXPECTED compiles may legitimately happen after
+        # warmup: False for the dense scorer (warmup compiles the whole
+        # ladder, so any later compile is the latency-cliff signal);
+        # True for the overlay scorer (compact-table buckets compile
+        # lazily, O(log) of them, by design).
+        self._lazy_expected_ok = False
+        self.steady_compiles = 0  # post-warmup latency-cliff compiles
+        self.compiles = 0
+        self.step = 0  # checkpoint step currently served (0 = in-memory)
+        F = cfg.max_features
+        sh = mesh_lib.batch_sharding(self.mesh)
+        self._arg_sh = (sh["ids"], sh["vals"], sh["fields"])
+        self._arg_dtypes = (np.int32, np.float32, np.int32)
+        self._n_args = 3 if cfg.field_num else 2
+        self._feat = F
+
+    # -- rung / pool helpers -------------------------------------------
+
+    def rung_for(self, n: int) -> int:
+        """Smallest ladder rung holding ``n`` examples (the max rung for
+        anything larger — callers chunk)."""
+        for b in self.ladder:
+            if n <= b:
+                return b
+        return self.max_rung
+
+    def slots_for(self, n: int) -> int:
+        """Total padded slots :meth:`score` dispatches for ``n``
+        examples — the chunk policy's accounting twin, kept HERE so
+        fill-fraction bookkeeping can never drift from the actual
+        chunking."""
+        slots = 0
+        pos = 0
+        while pos < n:
+            c = min(n - pos, self.max_rung)
+            slots += self.rung_for(c)
+            pos += c
+        return slots
+
+    def _pool(self, b: int):
+        bufs = self._pools.get(b)
+        if bufs is None:
+            bufs = tuple(
+                np.zeros((b, self._feat), dt) for dt in self._arg_dtypes
+            )
+            self._pools[b] = bufs
+        return bufs
+
+    def _finish(self, s):
+        """Score post-processing shared by both variants: probabilities
+        for logistic loss, raw scores for mse (predict's contract)."""
+        if self.cfg.loss_type == "logistic":
+            s = jax.nn.sigmoid(s)
+        return s
+
+    def _aot_fail(self, e: BaseException):
+        """Permanent fallback on AOT API drift: dispatch through the
+        plain jit (identical math; compiles become invisible to the
+        zero-compile accounting, so say so loudly)."""
+        self._aot_broken = True
+        log.warning(
+            "serve AOT compile path unavailable (%s: %s); falling back "
+            "to plain jit dispatch (compiles become invisible to the "
+            "zero-compile accounting)", type(e).__name__, e,
+        )
+        return self._jit
+
+    # -- compile accounting --------------------------------------------
+
+    def _account_compile(self, wall: float, key, expected: bool) -> None:
+        self._t_compile.observe(wall)
+        self.compiles += 1
+        if self._warmed and not (expected and self._lazy_expected_ok):
+            self.steady_compiles += 1
+        if not expected:
+            self._c_unexpected.add()
+            log.warning(
+                "UNEXPECTED serve-path compile (%s, %.2fs): the shape "
+                "is outside the configured serve_batch_sizes ladder — "
+                "a multi-second latency cliff on the hot path",
+                key, wall,
+            )
+        if self._writer is not None:
+            try:
+                self._writer.write({
+                    "record": "compile",
+                    "where": "serve",
+                    "time": time.time(),
+                    "shape": list(key) if isinstance(key, tuple) else key,
+                    "compile_s": round(wall, 4),
+                    "expected": bool(expected),
+                })
+            except Exception as e:  # noqa: BLE001 - never kill a compile
+                log.warning("serve compile record write failed: %s", e)
+
+    def warmup(self) -> int:
+        """Precompile every ladder rung; returns the compile count.
+        After this returns, a correctly-configured server never
+        compiles again (``steady_compiles`` stays 0)."""
+        with self._lock:
+            for b in self.ladder:
+                self._warm_rung(b)
+        self._warmed = True
+        self.steady_compiles = 0
+        return self.compiles
+
+    # -- scoring -------------------------------------------------------
+
+    def score(self, ids: np.ndarray, vals: np.ndarray,
+              fields: Optional[np.ndarray] = None) -> np.ndarray:
+        """Scores for ``n`` examples (``[n, max_features]`` arrays), any
+        ``n``: chunks at the max rung, pads the tail chunk up to its
+        rung with zero rows (``vals == 0`` rows are mathematically inert
+        and their outputs are discarded)."""
+        n = len(ids)
+        out = np.empty((n,), np.float32)
+        pos = 0
+        with self._lock:
+            while pos < n:
+                c = min(n - pos, self.max_rung)
+                b = self.rung_for(c)
+                bi, bv, bf = self._pool(b)
+                bi[:c] = ids[pos:pos + c]
+                bv[:c] = vals[pos:pos + c]
+                if c < b:
+                    bi[c:] = 0
+                    bv[c:] = 0.0
+                if self._n_args == 3:
+                    if fields is not None:
+                        bf[:c] = fields[pos:pos + c]
+                    else:
+                        bf[:c] = 0
+                    if c < b:
+                        bf[c:] = 0
+                scores = self._dispatch_rung(bi, bv, bf, b)
+                out[pos:pos + c] = scores[:c]
+                pos += c
+        return out
+
+    def score_rung(self, ids: np.ndarray, vals: np.ndarray,
+                   fields: Optional[np.ndarray], b: int) -> np.ndarray:
+        """One dispatch of exactly-rung-shaped arrays (the batcher's
+        entry: it fills the pooled buffers itself)."""
+        with self._lock:
+            if fields is None:
+                fields = self._pool(b)[2]
+                if self._n_args == 3:
+                    # The pool buffer is shared across dispatches: a
+                    # fields-less group must not score against field
+                    # values a previous group left behind.
+                    fields[:] = 0
+            return self._dispatch_rung(ids, vals, fields, b)
+
+    # -- subclass hooks ------------------------------------------------
+
+    def _warm_rung(self, b: int) -> None:
+        raise NotImplementedError
+
+    def _dispatch_rung(self, ids, vals, fields, b: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class FixedShapeScorer(_LadderScorer):
+    """Dense-table scorer: params device-resident, hot-swappable.
+
+    ``params`` may be a host-numpy or device :class:`fm.FmParams`; it is
+    placed with the mesh's param sharding either way.
+    """
+
+    def __init__(self, cfg: FmConfig, params: fm.FmParams, mesh=None,
+                 telemetry=None, writer=None, extra_rungs=(), step=0):
+        super().__init__(cfg, mesh=mesh, telemetry=telemetry,
+                         writer=writer, extra_rungs=extra_rungs)
+        self.step = int(step)
+        self._param_sh = mesh_lib.param_sharding(self.mesh)
+        self._params = self._place(params)
+        if cfg.field_num:
+            def score_fn(params, ids, vals, fields):
+                return self._finish(fm.fm_scores(
+                    params, ids, vals, fields,
+                    factor_num=cfg.factor_num, field_num=cfg.field_num,
+                ))
+        else:
+            def score_fn(params, ids, vals):
+                return self._finish(fm.fm_scores(
+                    params, ids, vals, None,
+                    factor_num=cfg.factor_num, field_num=0,
+                ))
+        self._jit = jax.jit(
+            score_fn,
+            in_shardings=(
+                (self._param_sh,) + self._arg_sh[:self._n_args]
+            ),
+            donate_argnums=tuple(range(1, 1 + self._n_args)),
+        )
+
+    def _place(self, params: fm.FmParams) -> fm.FmParams:
+        placed = fm.FmParams(
+            w0=jax.device_put(
+                jnp.asarray(params.w0, jnp.float32), self._param_sh.w0
+            ),
+            table=jax.device_put(
+                jnp.asarray(params.table, jnp.float32),
+                self._param_sh.table,
+            ),
+        )
+        jax.block_until_ready(placed)
+        return placed
+
+    def swap(self, params: fm.FmParams, step: int = 0) -> None:
+        """Warm hot-swap: stage the new params into standby device
+        buffers (off the dispatch lock — traffic keeps scoring the old
+        table), then swap the reference atomically between dispatches.
+        Shapes are unchanged, so the compiled rungs serve on with zero
+        recompiles; no request ever sees a torn table."""
+        placed = self._place(params)  # standby buffers, fully resident
+        with self._swap_lock:
+            self._params = placed
+            self.step = int(step)
+        self._c_swaps.add()
+        log.info("serving params hot-swapped to step %d", step)
+
+    def _compiled(self, b: int):
+        fn = self._cache.get(b)
+        if fn is not None:
+            return fn
+        if self._aot_broken:
+            return self._jit
+        structs = tuple(
+            jax.ShapeDtypeStruct((b, self._feat), dt)
+            for dt in self._arg_dtypes[:self._n_args]
+        )
+        p_struct = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._params
+        )
+        t0 = time.perf_counter()
+        try:
+            with _quiet_donation():
+                fn = self._jit.lower(p_struct, *structs).compile()
+        except Exception as e:  # pragma: no cover - jax API drift
+            return self._aot_fail(e)
+        self._account_compile(
+            time.perf_counter() - t0, b, expected=b in self._ladder_set
+        )
+        self._cache[b] = fn
+        return fn
+
+    def _warm_rung(self, b: int) -> None:
+        self._compiled(b)
+
+    def _dispatch_rung(self, ids, vals, fields, b: int) -> np.ndarray:
+        with self._t_dispatch.time():
+            fn = self._compiled(b)
+            with self._swap_lock:
+                params = self._params
+            if self._n_args == 3:
+                out = fn(params, ids, vals, fields)
+            else:
+                out = fn(params, ids, vals)
+            # The blocking host read is part of the dispatch: the score
+            # goes back to a client, so D2H latency is request latency.
+            return np.asarray(out)
+
+
+class OverlayScorer(_LadderScorer):
+    """Huge-V scorer over a ``tiered.npz`` sparse-overlay checkpoint.
+
+    Per chunk: the chunk's unique logical ids gather their current rows
+    from the host cold store (written overlay + deterministic init),
+    the compact table bucket-pads to O(log) distinct row counts
+    (``tiered._bucket``), and ids remap to local indices — identical
+    math to a full-table gather without ever materializing [V, D].
+    Compile cache keys on (rung, bucketed rows): both dimensions come
+    from small ladders, so the executable set stays tiny and every
+    compile at a bucketed shape is expected.
+    """
+
+    def __init__(self, cfg: FmConfig, w0: float, store, mesh=None,
+                 telemetry=None, writer=None, extra_rungs=(), step=0):
+        super().__init__(cfg, mesh=mesh, telemetry=telemetry,
+                         writer=writer, extra_rungs=extra_rungs)
+        self.step = int(step)
+        self._lazy_expected_ok = True  # bucket shapes compile lazily
+        self._rep = NamedSharding(self.mesh, P())
+        self._model = (np.float32(w0), store)
+        dim = cfg.embedding_dim
+        if cfg.field_num:
+            def score_fn(w0, table, ids, vals, fields):
+                return self._finish(fm.fm_scores(
+                    fm.FmParams(w0=w0, table=table), ids, vals, fields,
+                    factor_num=cfg.factor_num, field_num=cfg.field_num,
+                ))
+        else:
+            def score_fn(w0, table, ids, vals):
+                return self._finish(fm.fm_scores(
+                    fm.FmParams(w0=w0, table=table), ids, vals, None,
+                    factor_num=cfg.factor_num, field_num=0,
+                ))
+        # The compact table is replicated: it is per-chunk data, not the
+        # sharded logical table (which never materializes).
+        self._jit = jax.jit(
+            score_fn,
+            in_shardings=(
+                (self._rep, self._rep)
+                + tuple(
+                    NamedSharding(self.mesh, P(mesh_lib.DATA_AXIS, None))
+                    for _ in range(self._n_args)
+                )
+            ),
+            donate_argnums=tuple(range(2, 2 + self._n_args)),
+        )
+        self._dim = dim
+
+    def swap(self, w0: float, store, step: int = 0) -> None:
+        """Hot-swap to a freshly restored overlay (new cold store +
+        scalars).  One reference swap between dispatches — a chunk
+        gathers its compact table from exactly one store."""
+        with self._swap_lock:
+            self._model = (np.float32(w0), store)
+            self.step = int(step)
+        self._c_swaps.add()
+        log.info("serving overlay hot-swapped to step %d", step)
+
+    def _compiled(self, b: int, rows: int):
+        key = (b, rows)
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
+        if self._aot_broken:
+            return self._jit
+        structs = (
+            jax.ShapeDtypeStruct((), np.float32),
+            jax.ShapeDtypeStruct((rows, self._dim), np.float32),
+        ) + tuple(
+            jax.ShapeDtypeStruct((b, self._feat), dt)
+            for dt in self._arg_dtypes[:self._n_args]
+        )
+        t0 = time.perf_counter()
+        try:
+            with _quiet_donation():
+                fn = self._jit.lower(*structs).compile()
+        except Exception as e:  # pragma: no cover - jax API drift
+            return self._aot_fail(e)
+        # Bucketed compact-table shapes are all expected: the row
+        # ladder is log-sized by construction, the rung ladder by
+        # config.  Off-ladder RUNGS still flag.
+        expected = b in self._ladder_set and rows == tiered_lib._bucket(
+            max(1, rows), lo=8
+        )
+        self._account_compile(time.perf_counter() - t0, key, expected)
+        self._cache[key] = fn
+        return fn
+
+    def _warm_rung(self, b: int) -> None:
+        # Warm the smallest compact-table bucket per rung; larger
+        # buckets compile lazily (still expected — log-many of them).
+        self._compiled(b, tiered_lib._bucket(1))
+
+    def _dispatch_rung(self, ids, vals, fields, b: int) -> np.ndarray:
+        with self._t_dispatch.time():
+            with self._swap_lock:
+                w0, store = self._model
+            vocab = self.cfg.vocabulary_size
+            flat = ids.reshape(-1).astype(np.int64, copy=False)
+            safe = np.where((flat >= 0) & (flat < vocab), flat, 0)
+            u, inv = np.unique(safe, return_inverse=True)
+            rows = tiered_lib._bucket(max(1, len(u)))
+            mini = np.zeros((rows, self._dim), np.float32)
+            mini[:len(u)] = store.gather(u)
+            local_ids = inv.astype(np.int32).reshape(ids.shape)
+            fn = self._compiled(b, rows)
+            if self._n_args == 3:
+                out = fn(w0, mini, local_ids, vals, fields)
+            else:
+                out = fn(w0, mini, local_ids, vals)
+            return np.asarray(out)
+
+
+# ----------------------------------------------------------------------
+# checkpoint loading (construction + the hot-swap watcher's reload)
+# ----------------------------------------------------------------------
+
+
+def load_model(cfg: FmConfig, mesh=None):
+    """Load the servable model from ``cfg.model_file``.
+
+    Returns ``("dense", step, device FmParams)`` or ``("tiered", step,
+    (w0, params ColdStore))`` — whichever format the checkpoint
+    directory holds (the two are mutually exclusive; the save paths
+    enforce that).  Raises if neither exists.
+
+    Dense restores carry the local mesh's TARGET shardings (the same
+    template discipline the trainer/old-predict used): orbax places
+    each shard directly where this topology wants it, so a checkpoint
+    saved on more devices restores fine on fewer — restoring through
+    sharding-less host templates would fall back to the
+    sharding-from-file path orbax documents as topology-unsafe.
+    """
+    if checkpoint.exists_tiered(cfg.model_file):
+        step, scalars, stores = checkpoint.restore_tiered(cfg.model_file)
+        payload = stores["table"]
+        want = tiered_lib._virtual_descriptor(cfg, "table")
+        got = payload.get("descriptor")
+        if got is not None and got != want:
+            raise ValueError(
+                f"tiered checkpoint store 'table' was written under a "
+                f"different init ({got} != {want}); seed/"
+                "init_value_range must match the run that saved it"
+            )
+        store = tiered_lib._virtual_store(cfg, "table")
+        store.import_overlay(payload)
+        return "tiered", step, (float(scalars["w0"]), store)
+    if checkpoint.exists(cfg.model_file):
+        mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
+        param_sh = mesh_lib.param_sharding(mesh)
+        shapes = jax.eval_shape(
+            partial(fm.init_params, cfg=cfg), jax.random.PRNGKey(0)
+        )
+        template = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=sh
+            ),
+            shapes, param_sh,
+        )
+        params, step = checkpoint.restore_params(cfg.model_file, template)
+        return "dense", step, fm.FmParams(*params)
+    raise ValueError(
+        f"no servable checkpoint at {cfg.model_file} (neither the "
+        "dense params/opt dirs nor a tiered.npz overlay)"
+    )
+
+
+def make_scorer(cfg: FmConfig, mesh=None, telemetry=None, writer=None,
+                extra_rungs=()):
+    """Build the right scorer for whatever ``cfg.model_file`` holds."""
+    fmt, step, model = load_model(cfg, mesh=mesh)
+    if fmt == "tiered":
+        w0, store = model
+        return OverlayScorer(
+            cfg, w0, store, mesh=mesh, telemetry=telemetry,
+            writer=writer, extra_rungs=extra_rungs, step=step,
+        )
+    return FixedShapeScorer(
+        cfg, model, mesh=mesh, telemetry=telemetry, writer=writer,
+        extra_rungs=extra_rungs, step=step,
+    )

@@ -1,0 +1,474 @@
+"""The scoring endpoint: HTTP front door, hot-swap watcher, lifecycle.
+
+:class:`ServeServer` mounts the batcher behind a stdlib
+ThreadingHTTPServer (the same server discipline as ``obs/status.py`` —
+daemon thread, read-only observability routes, degrade-don't-die):
+
+- ``POST /score`` — body is libsvm/ffm text, one example per line in
+  exactly the ``predict_files`` format (label column present but
+  ignored; lines whose first token contains ``:`` are accepted
+  label-less).  Response: one score per non-blank line, ``%.6f`` —
+  byte-identical formatting to offline ``predict``'s ``score_path``.
+- ``GET /metrics`` / ``/status`` / ``/healthz`` — the live
+  observability surface, rendered by the same
+  ``obs.status.render_prometheus`` the trainer's endpoint uses; all
+  ``serve.*`` instruments plus a ``serve`` record block (qps, latency
+  percentiles, batch fill, swaps) show up as ``tffm_serve_*`` series.
+
+:class:`CheckpointWatcher` is the warm hot-swap driver: it polls the
+``serve_manifest.json`` the trainer's save path publishes (the manifest
+is written AFTER the checkpoint files, so a published step is always a
+complete checkpoint), reloads the params into standby buffers
+off-traffic, and calls ``scorer.swap`` — zero recompiles (shapes
+unchanged), zero dropped requests (one reference swap between
+dispatches).  A reload that races the NEXT save simply fails, warns,
+and retries at the next poll.
+
+:func:`serve` builds the whole stack from an :class:`FmConfig`
+(scorer -> warmup -> batcher -> watcher -> HTTP) and returns a
+:class:`ServeHandle`; :func:`serve_forever` is the CLI entry
+(``run_tffm.py serve <cfg>``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data import libsvm
+from fast_tffm_tpu.obs.status import QuietHandler
+from fast_tffm_tpu.serve.batcher import ServeBatcher
+from fast_tffm_tpu.serve import scorer as scorer_lib
+from fast_tffm_tpu.train import checkpoint
+
+log = logging.getLogger(__name__)
+
+# POST /score body cap: far above any sane scoring request (a 64 MiB
+# libsvm body is ~1M examples), far below what would hurt the host.
+_MAX_BODY_BYTES = 64 << 20
+
+__all__ = [
+    "CheckpointWatcher", "ServeHandle", "ServeServer", "parse_request",
+    "serve", "serve_forever",
+]
+
+
+def parse_request(text: str, cfg: FmConfig):
+    """Request body -> ``(ids, vals, fields, n, truncated)`` arrays.
+
+    One example per non-blank, non-comment line, ``predict_files``
+    format.  A line whose FIRST token contains ``:`` is treated as
+    label-less (scoring clients rarely have labels); anything else goes
+    through :func:`libsvm.parse_line` unchanged, so request files and
+    predict files are interchangeable.  NOTE the inherent libsvm
+    ambiguity this rule resolves deterministically: a line of BARE
+    feature ids ("123 456 789") is indistinguishable from a labeled
+    line, so its first token is always read as the label — bare-id
+    clients must send an explicit label column (or ``id:1`` tokens);
+    documented in SERVING.md.  Raises ValueError (-> HTTP 400) on a
+    malformed line.  ``truncated`` counts feature occurrences
+    dropped by ``max_features`` — a truncated example scores as a
+    DIFFERENT example, the same data-integrity event the ingest path
+    surfaces as ``ingest.truncated_features`` (the server counts it as
+    ``serve.truncated_features``).
+    """
+    examples = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if ":" in stripped.split(None, 1)[0]:
+            stripped = "0 " + stripped
+        try:
+            ex = libsvm.parse_line(
+                stripped, cfg.vocabulary_size, cfg.hash_feature_id,
+                cfg.field_num,
+            )
+        except ValueError as e:
+            raise ValueError(f"line {lineno}: {e}") from e
+        if ex is not None:
+            examples.append(ex)
+    n = len(examples)
+    F = cfg.max_features
+    ids = np.zeros((n, F), np.int32)
+    vals = np.zeros((n, F), np.float32)
+    fields = np.zeros((n, F), np.int32)
+    truncated = 0
+    for i, ex in enumerate(examples):
+        k = min(len(ex.ids), F)
+        truncated += len(ex.ids) - k
+        ids[i, :k] = ex.ids[:k]
+        vals[i, :k] = ex.vals[:k]
+        fields[i, :k] = ex.fields[:k]
+    return ids, vals, fields, n, truncated
+
+
+class CheckpointWatcher:
+    """Poll the save-path manifest; hot-swap the scorer on a new step.
+
+    ``seen`` is the baseline manifest the currently-served params came
+    from; the owner should capture it BEFORE loading the checkpoint
+    (serve() does), so a save landing during load/warmup is still
+    picked up at the first poll instead of being silently baselined
+    away.  Omitted -> read at construction (direct/test use).
+    """
+
+    def __init__(self, cfg: FmConfig, scorer, poll_secs: float,
+                 on_swap=None, seen=None):
+        self._cfg = cfg
+        self._scorer = scorer
+        self._poll = max(0.05, float(poll_secs))
+        self._on_swap = on_swap
+        self._seen = (
+            seen if seen is not None
+            else checkpoint.read_manifest(cfg.model_file)
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tffm-serve-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                self._check_once()
+            except Exception as e:  # noqa: BLE001 - retry next poll
+                log.warning(
+                    "checkpoint watcher: reload failed (%s); will "
+                    "retry next poll", e,
+                )
+
+    def _check_once(self) -> None:
+        man = checkpoint.read_manifest(self._cfg.model_file)
+        if man is None or man == self._seen:
+            return
+        fmt, step, model = scorer_lib.load_model(
+            self._cfg, mesh=self._scorer.mesh
+        )
+        scorer = self._scorer
+        if fmt == "tiered" and isinstance(
+            scorer, scorer_lib.OverlayScorer
+        ):
+            scorer.swap(*model, step=step)
+        elif fmt == "dense" and isinstance(
+            scorer, scorer_lib.FixedShapeScorer
+        ):
+            scorer.swap(model, step=step)
+        else:
+            log.warning(
+                "checkpoint at %s changed FORMAT (%s) mid-serve; a "
+                "running server cannot cross dense<->tiered — restart "
+                "to pick it up", self._cfg.model_file, fmt,
+            )
+            self._seen = man
+            return
+        self._seen = man
+        if self._on_swap is not None:
+            self._on_swap(step)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join()
+
+
+class ServeServer:
+    """HTTP front door: ``POST /score`` + the observability routes."""
+
+    def __init__(self, port: int, batcher: ServeBatcher, cfg: FmConfig,
+                 build, telemetry=None, host: str = "127.0.0.1",
+                 timeout_s: float = 30.0):
+        tel = telemetry if telemetry is not None else obs.NULL
+        requests_c = tel.counter("serve.http_requests")
+        truncated_c = tel.counter("serve.truncated_features")
+        server = self
+
+        class Handler(QuietHandler):
+            def do_POST(self) -> None:  # noqa: N802 - http.server API
+                requests_c.add()
+                if self.path.partition("?")[0] != "/score":
+                    self._send(404, b"not found\n", "text/plain")
+                    return
+                if "Content-Length" not in self.headers:
+                    # Without a length the body is unreadable here
+                    # (chunked encoding): answering 200-empty would
+                    # silently drop the client's examples.
+                    self._send(
+                        411, b"Content-Length required (chunked "
+                             b"transfer is not supported)\n",
+                        "text/plain",
+                    )
+                    return
+                try:
+                    length = int(self.headers["Content-Length"])
+                except ValueError:
+                    self._send(400, b"bad Content-Length\n", "text/plain")
+                    return
+                # The client's length is untrusted input on an
+                # unauthenticated endpoint: a negative value would
+                # read-to-EOF (handler thread pinned until the client
+                # hangs up), an absurd one would buffer it all.
+                if length < 0:
+                    self._send(400, b"bad Content-Length\n", "text/plain")
+                    return
+                if length > _MAX_BODY_BYTES:
+                    self._send(
+                        413, f"request body over the "
+                             f"{_MAX_BODY_BYTES >> 20} MiB cap; split "
+                             f"it\n".encode(), "text/plain",
+                    )
+                    return
+                try:
+                    text = self.rfile.read(length).decode()
+                    ids, vals, fields, n, truncated = parse_request(
+                        text, cfg
+                    )
+                except (ValueError, UnicodeDecodeError) as e:
+                    self._send(
+                        400, f"bad request: {e}\n".encode(), "text/plain"
+                    )
+                    return
+                if truncated:
+                    # Same integrity signal the ingest path counts: a
+                    # truncated example scores as a different example.
+                    truncated_c.add(truncated)
+                if n == 0:
+                    self._send(200, b"", "text/plain")
+                    return
+                try:
+                    scores = batcher.score(
+                        ids, vals,
+                        fields if cfg.field_num else None,
+                        timeout=timeout_s,
+                    )
+                except Exception as e:  # noqa: BLE001 - report, don't die
+                    self._send(
+                        503, f"scoring failed: {e}\n".encode(),
+                        "text/plain",
+                    )
+                    return
+                body = "".join(f"{s:.6f}\n" for s in scores).encode()
+                self._send(200, body, "text/plain")
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                requests_c.add()
+                path = self.path.partition("?")[0]
+                if self._get_observability(path, server._build):
+                    return
+                self._send(404, b"not found\n", "text/plain")
+
+        self._build = build
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tffm-serve-http",
+            daemon=True,
+        )
+        self._thread.start()
+        self._closed = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._thread.join()
+        self._httpd.server_close()
+
+
+class ServeHandle:
+    """One running serving stack; ``close()`` tears it down in order
+    (HTTP stops accepting, batcher drains/fails, watcher stops, final
+    record written)."""
+
+    def __init__(self, cfg, scorer, batcher, server, watcher, telemetry,
+                 writer, heartbeat, build):
+        self.cfg = cfg
+        self.scorer = scorer
+        self.batcher = batcher
+        self.server = server
+        self.watcher = watcher
+        self.telemetry = telemetry
+        self.port = server.port
+        self._writer = writer
+        self._heartbeat = heartbeat
+        self._build = build
+        self._closed = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.server.close()
+        if self.watcher is not None:
+            self.watcher.close()
+        self.batcher.close()
+        if self._heartbeat is not None:
+            self._heartbeat.close()
+        if self._writer is not None:
+            try:
+                final = self._build("final")
+                if final is not None:
+                    self._writer.write(final)
+            except Exception as e:  # noqa: BLE001 - teardown best-effort
+                log.warning("serve final record write failed: %s", e)
+            self._writer.close()
+
+
+def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
+    """The ``serve`` record block: flat, numeric, host-side only —
+    rendered as ``tffm_serve_*`` by /metrics and summarized by
+    tools/report.py.  ``snap`` is the one telemetry snapshot the whole
+    record is built from (one instrument-lock walk per scrape, and the
+    block can never disagree with ``stages``)."""
+    counters = snap.get("counters") or {}
+    timers = snap.get("timers") or {}
+    lat = timers.get("serve.latency") or {}
+    requests = int(counters.get("serve.requests", 0))
+    out = {
+        "requests": requests,
+        "examples": int(counters.get("serve.examples", 0)),
+        "batches": int(counters.get("serve.batches", 0)),
+        "qps": round(requests / wall, 2) if wall > 0 else 0.0,
+        "batch_fill": round(batcher.batch_fill, 6),
+        "swaps": int(counters.get("serve.swaps", 0)),
+        "compiles": int(scorer.compiles),
+        "steady_compiles": int(scorer.steady_compiles),
+        "recompiles_unexpected": int(
+            counters.get("serve.recompiles_unexpected", 0)
+        ),
+        "truncated_features": int(
+            counters.get("serve.truncated_features", 0)
+        ),
+    }
+    for key in ("mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
+        if key in lat:
+            out[key] = lat[key]
+    return out
+
+
+def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
+          ) -> ServeHandle:
+    """Build and start the full serving stack from a config.
+
+    ``port`` overrides ``cfg.serve_port`` (tests pass 0 for an
+    OS-assigned port; the bound port is ``handle.port``).
+    """
+    writer = (
+        obs.JsonlWriter(cfg.metrics_file) if cfg.metrics_file else None
+    )
+    telemetry = obs.Telemetry(enabled=cfg.telemetry)
+    # Watcher baseline BEFORE the load: a checkpoint published while we
+    # load/warm up must look NEW to the first poll (the scorer may or
+    # may not have caught it; re-swapping to the same step is a cheap
+    # no-op, serving stale params forever is not).
+    manifest_baseline = checkpoint.read_manifest(cfg.model_file)
+    try:
+        scorer = scorer_lib.make_scorer(
+            cfg, mesh=mesh, telemetry=telemetry, writer=writer
+        )
+        n_compiles = scorer.warmup()
+    except BaseException:
+        # No servable checkpoint / warmup failure: close the metrics
+        # writer behind the raise (callers retrying against a racing
+        # model dir must not accumulate leaked fds).
+        if writer is not None:
+            writer.close()
+        raise
+    log.info(
+        "scorer ready: checkpoint step %d, ladder %s, %d rung(s) "
+        "precompiled — steady-state serving performs zero compiles",
+        scorer.step, list(scorer.ladder), n_compiles,
+    )
+    batcher = ServeBatcher(
+        scorer, max_batch_wait_ms=cfg.max_batch_wait_ms,
+        queue_size=cfg.queue_size, telemetry=telemetry,
+    )
+    t0 = time.time()
+
+    def build(kind: str = "status"):
+        now = time.time()
+        wall = max(now - t0, 1e-9)
+        snap = telemetry.snapshot()
+        rec = {
+            "record": kind,
+            "time": now,
+            "elapsed": round(wall, 3),
+            "step": scorer.step,
+            "serve": _serve_block(snap, scorer, batcher, wall),
+            "stages": snap,
+        }
+        return rec
+
+    if writer is not None:
+        writer.write({
+            "record": "run_header",
+            "mode": "serve",
+            "time": t0,
+            "model_file": cfg.model_file,
+            "resume_step": scorer.step,
+            "serve_batch_sizes": list(scorer.ladder),
+            "max_batch_wait_ms": cfg.max_batch_wait_ms,
+            "serve_poll_secs": cfg.serve_poll_secs,
+            "batch_size": cfg.batch_size,
+            "telemetry": cfg.telemetry,
+            "heartbeat_secs": cfg.heartbeat_secs,
+        })
+    heartbeat = None
+    if cfg.heartbeat_secs > 0:
+        heartbeat = obs.Heartbeat(
+            cfg.heartbeat_secs, lambda: build("heartbeat"),
+            writer=writer,
+        )
+    watcher = None
+    try:
+        if cfg.serve_poll_secs > 0:
+            watcher = CheckpointWatcher(
+                cfg, scorer, cfg.serve_poll_secs,
+                seen=manifest_baseline,
+            )
+        server = ServeServer(
+            cfg.serve_port if port is None else port,
+            batcher, cfg, build, telemetry=telemetry, host=cfg.serve_host,
+        )
+    except BaseException:
+        # A taken port (or watcher failure) must not leak the batcher
+        # dispatcher / watcher / heartbeat threads behind the raise.
+        if watcher is not None:
+            watcher.close()
+        batcher.close()
+        if heartbeat is not None:
+            heartbeat.close()
+        if writer is not None:
+            writer.close()
+        raise
+    log.info(
+        "scoring endpoint listening on %s:%d (POST /score; GET "
+        "/metrics, /status, /healthz, /debug/threadz)",
+        cfg.serve_host, server.port,
+    )
+    return ServeHandle(
+        cfg, scorer, batcher, server, watcher, telemetry, writer,
+        heartbeat, build,
+    )
+
+
+def serve_forever(cfg: FmConfig) -> int:
+    """CLI entry: serve until interrupted (SIGINT -> clean close)."""
+    handle = serve(cfg)
+    print(f"serving on {cfg.serve_host}:{handle.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        log.info("interrupted; shutting down the scoring endpoint")
+    finally:
+        handle.close()
+    return 0

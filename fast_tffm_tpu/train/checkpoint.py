@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -77,7 +78,38 @@ def save(
         with open(tmp, "w") as f:
             json.dump(data_state, f)
         os.replace(tmp, _data_state_path(model_file))
+    _publish_manifest(model_file, step, "dense")
     log.info("saved checkpoint step=%d to %s", step, model_file)
+
+
+def _manifest_path(model_file: str) -> str:
+    return os.path.join(os.path.abspath(model_file), "serve_manifest.json")
+
+
+def _publish_manifest(model_file: str, step: int, fmt: str) -> None:
+    """Publish the serving manifest AFTER the checkpoint files land.
+
+    The manifest is the hot-swap handshake with the serving path
+    (serve.CheckpointWatcher): because it is written last (atomic
+    rename), a server that sees a new manifest knows the checkpoint it
+    names is complete.  ``published`` disambiguates re-saves at the
+    same step (a warm restart that trains zero new steps still
+    republishes).
+    """
+    doc = {"step": int(step), "format": fmt, "published": time.time()}
+    tmp = _manifest_path(model_file) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, _manifest_path(model_file))
+
+
+def read_manifest(model_file: str) -> Optional[dict]:
+    """The published serving manifest, or None (absent / mid-write)."""
+    try:
+        with open(_manifest_path(model_file)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
 
 
 def restore_data_state(model_file: str) -> Optional[dict]:
@@ -175,6 +207,7 @@ def save_tiered(
         with open(dtmp, "w") as f:
             json.dump(data_state, f)
         os.replace(dtmp, _data_state_path(model_file))
+    _publish_manifest(model_file, step, "tiered")
     log.info("saved tiered overlay checkpoint step=%d to %s", step, path)
 
 

@@ -2142,6 +2142,18 @@ def predict(cfg: FmConfig, mesh=None) -> int:
 
     Scores are written in input order, one per line — sigmoid probabilities
     for logistic loss, raw scores for mse.
+
+    Scoring routes through the SAME fixed-shape scorer ladder the
+    online serving path uses (fast_tffm_tpu/serve/scorer.py): batches
+    pad into a small set of precompiled shapes (the file's batches plus
+    ``serve_batch_sizes``), so ragged shapes never retrace — every
+    compile is an explicit, accounted event (``record: compile`` when
+    ``metrics_file`` is set; off-ladder shapes bump
+    ``serve.recompiles_unexpected``), and served scores are
+    bitwise-identical to this offline path by construction.  Tiered
+    sparse-overlay checkpoints (``tiered.npz``) score through the
+    compact per-batch remap (serve.OverlayScorer) instead of requiring
+    a dense merge.
     """
     if not cfg.predict_files:
         raise ValueError("no predict_files configured")
@@ -2151,44 +2163,36 @@ def predict(cfg: FmConfig, mesh=None) -> int:
             "worker too); run it without jax.distributed — the sharded "
             "checkpoint restores fine on fewer devices"
         )
-    if checkpoint.exists_tiered(cfg.model_file):
-        raise NotImplementedError(
-            "this checkpoint is a tiered sparse overlay "
-            "(table_tiering=on at a vocabulary too large to merge "
-            "densely); predict needs a dense-format checkpoint — score "
-            "through a tiered Trainer instead (see EMBEDDING.md)"
-        )
+    from fast_tffm_tpu.serve import scorer as serve_scorer
+
     mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
-    param_sh = mesh_lib.param_sharding(mesh)
-    template = _params_template(cfg, param_sh)
-    params, _ = checkpoint.restore_params(cfg.model_file, template)
-    params = fm.FmParams(*params)
-
-    batch_sh = Batch(**mesh_lib.batch_sharding(mesh))
-
-    @partial(jax.jit, in_shardings=(param_sh, batch_sh))
-    def score_fn(params, batch):
-        s = fm.fm_scores(
-            params,
-            batch.ids,
-            batch.vals,
-            batch.fields if cfg.field_num else None,
-            factor_num=cfg.factor_num,
-            field_num=cfg.field_num,
-        )
-        if cfg.loss_type == "logistic":
-            s = jax.nn.sigmoid(s)
-        return s
-
-    pipeline = BatchPipeline(
-        cfg.predict_files, cfg, epochs=1, shuffle=False, ordered=True
+    writer = (
+        obs.JsonlWriter(cfg.metrics_file) if cfg.metrics_file else None
     )
+    telemetry = obs.Telemetry(enabled=cfg.telemetry)
     n = 0
-    with open(cfg.score_path, "w") as out:
-        for batch in pipeline:
-            scores = np.asarray(score_fn(params, mesh_lib.shard_batch(batch, mesh)))
-            for s in scores[batch.weights > 0]:
-                out.write(f"{s:.6f}\n")
-                n += 1
-    log.info("wrote %d scores to %s", n, cfg.score_path)
+    try:
+        scorer = serve_scorer.make_scorer(
+            cfg, mesh=mesh, telemetry=telemetry, writer=writer,
+            # The pipeline delivers [batch_size] batches; making that a
+            # rung means the whole offline run compiles exactly once
+            # per distinct shape it actually scores.
+            extra_rungs=(cfg.batch_size,),
+        )
+        pipeline = BatchPipeline(
+            cfg.predict_files, cfg, epochs=1, shuffle=False, ordered=True
+        )
+        with open(cfg.score_path, "w") as out:
+            for batch in pipeline:
+                scores = scorer.score(batch.ids, batch.vals, batch.fields)
+                for s in scores[batch.weights > 0]:
+                    out.write(f"{s:.6f}\n")
+                    n += 1
+    finally:
+        if writer is not None:
+            writer.close()
+    log.info(
+        "wrote %d scores to %s (%d scorer compile(s), checkpoint "
+        "step %d)", n, cfg.score_path, scorer.compiles, scorer.step,
+    )
     return n

@@ -18,6 +18,15 @@ SUBPROCESS (pinned to CPU), not an in-process Trainer — and asserts:
    busy-guard rejects a CONCURRENT second request with 409;
 5. the run itself exits 0.
 
+Then the SERVE smoke (the online scoring path, SERVING.md) against the
+checkpoint that run just wrote — ``run_tffm.py serve`` in a subprocess:
+
+6. ``POST /score`` answers with one parseable score per input line;
+7. ``/metrics`` serves the ``tffm_serve_*`` series (Prometheus-valid);
+8. a second short training run into the same model dir republishes the
+   checkpoint manifest, and the server HOT-SWAPS exactly as designed
+   (``tffm_counter_serve_swaps_total`` reaches 1) while still scoring.
+
 Exit 0 = all held; any other exit fails the audit.
 """
 
@@ -185,6 +194,124 @@ def check_capture_routes(port: int) -> None:
           f"{doc['profile_dir']}, concurrent request got 409")
 
 
+def check_serve(cfg_path: str, data: str) -> None:
+    """Serve smoke: score over the socket, scrape tffm_serve_*, and
+    assert one warm hot-swap when the trainer republishes the
+    checkpoint.  Runs against the model dir the training smoke wrote."""
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "run_tffm.py"), "serve",
+         cfg_path, "--serve_port", str(port),
+         "--serve_poll_secs", "0.2"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 120
+        while True:
+            try:
+                urllib.request.urlopen(f"{base}/healthz", timeout=2)
+                break
+            except (urllib.error.URLError, OSError) as e:
+                if proc.poll() is not None:
+                    out, _ = proc.communicate()
+                    sys.stderr.write(
+                        out.decode(errors="replace")[-2000:]
+                    )
+                    raise SystemExit(
+                        f"FAIL: serve exited {proc.returncode} before "
+                        f"answering ({e})"
+                    )
+                if time.time() > deadline:
+                    raise SystemExit(
+                        f"FAIL: serve endpoint unreachable ({e})"
+                    )
+                time.sleep(0.2)
+        with open(data) as f:
+            lines = "".join(f.readline() for _ in range(10))
+        req = urllib.request.Request(
+            f"{base}/score", data=lines.encode(), method="POST"
+        )
+        body = urllib.request.urlopen(req, timeout=30).read().decode()
+        scores = body.strip().splitlines()
+        if len(scores) != 10 or not all(
+            0.0 <= float(s) <= 1.0 for s in scores
+        ):
+            raise SystemExit(
+                f"FAIL: /score answered {len(scores)} line(s) for 10 "
+                f"examples: {body[:200]!r}"
+            )
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=10).read().decode()
+        check_prometheus(metrics)
+        for series in ("tffm_counter_serve_requests_total",
+                       "tffm_counter_serve_examples_total",
+                       "tffm_timer_serve_latency_p99_ms",
+                       "tffm_gauge_serve_batch_fill"):
+            if series not in metrics:
+                raise SystemExit(
+                    f"FAIL: /metrics missing serve series {series}"
+                )
+        # Hot swap: a short warm-start training run into the same model
+        # dir republishes the manifest; the server must swap without
+        # dropping its socket.
+        swap_cfg = cfg_path + ".swap"
+        with open(cfg_path) as f:
+            content = f.read().replace("epoch_num = 20", "epoch_num = 1")
+        with open(swap_cfg, "w") as f:
+            f.write(content)
+        train = subprocess.run(
+            [sys.executable, os.path.join(REPO, "run_tffm.py"), "train",
+             swap_cfg],
+            cwd=REPO, env=env, capture_output=True, timeout=180,
+        )
+        if train.returncode != 0:
+            sys.stderr.write(
+                train.stdout.decode(errors="replace")[-2000:]
+            )
+            raise SystemExit(
+                f"FAIL: hot-swap training run exited {train.returncode}"
+            )
+        deadline = time.time() + 60
+        swaps = 0
+        while time.time() < deadline:
+            metrics = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10).read().decode()
+            m = re.search(
+                r"^tffm_counter_serve_swaps_total (\d+)", metrics,
+                re.MULTILINE,
+            )
+            swaps = int(m.group(1)) if m else 0
+            if swaps >= 1:
+                break
+            time.sleep(0.3)
+        if swaps < 1:
+            raise SystemExit(
+                "FAIL: server never hot-swapped after the checkpoint "
+                "manifest was republished"
+            )
+        body2 = urllib.request.urlopen(
+            urllib.request.Request(
+                f"{base}/score", data=lines.encode(), method="POST"
+            ), timeout=30,
+        ).read().decode()
+        if len(body2.strip().splitlines()) != 10:
+            raise SystemExit("FAIL: /score broken after hot-swap")
+        print(f"serve smoke ok: scored 10/10 over the socket, "
+              f"tffm_serve_* series present, {swaps} hot-swap(s) "
+              f"mid-traffic")
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
 def main() -> int:
     port = _free_port()
     tmpdir = tempfile.mkdtemp(prefix="tffm_obs_smoke_")
@@ -269,11 +396,14 @@ max_features = 4
             f"obs smoke ok: /status step={status['step']}, /metrics "
             f"served {n} Prometheus samples, run exited 0"
         )
-        return 0
     finally:
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+    # The serve smoke scores against the checkpoint the run above just
+    # saved (run_tffm.py serve in its own subprocess).
+    check_serve(cfg_path, data)
+    return 0
 
 
 if __name__ == "__main__":

@@ -100,6 +100,8 @@ def _fmt_rate(v: float) -> str:
 def _print_header(header: dict) -> None:
     print("run:")
     for key in (
+        "mode", "model_file", "serve_batch_sizes", "max_batch_wait_ms",
+        "serve_poll_secs",
         "rank", "config_fingerprint", "steps_per_dispatch", "ingest_mode",
         "fast_ingest", "cache_epochs", "cache_prestacked", "ring_slots",
         "batch_size", "epoch_num",
@@ -142,19 +144,29 @@ def _print_breakdown(rec: dict) -> None:
     if rec.get("exception"):
         print(f"\n  !! run DIED with {rec['exception']}: "
               f"{rec.get('exception_msg', '')}")
-    print(f"\nwall-clock attribution ({kind} record, step "
-          f"{rec.get('step', '?')}, {wall:.1f}s):")
-    print(f"  waiting for input   {wait:>9.2f}s  ({100 * wait / wall:5.1f}%)"
-          f"   <- starvation: ingest too slow")
-    print(f"  dispatch            {disp:>9.2f}s  ({100 * disp / wall:5.1f}%)"
-          f"   <- enqueue + device backpressure")
-    print(f"  other               {other:>9.2f}s  "
-          f"({100 * other / wall:5.1f}%)   <- logging/validation/save")
-    verdict = (
-        "INGEST-BOUND (grow thread_num/parse_processes, or cache_epochs)"
-        if frac > 0.25 else "compute-bound (ingest keeps up)"
-    )
-    print(f"  ingest_wait_frac    {frac:>9.3f}    -> {verdict}")
+    # Serve streams carry no training attribution (no ingest, no
+    # dispatch loop) — the serve section below is their breakdown.
+    training_rec = "wait_input_s" in rec or "serve" not in rec
+    if training_rec:
+        print(f"\nwall-clock attribution ({kind} record, step "
+              f"{rec.get('step', '?')}, {wall:.1f}s):")
+        print(f"  waiting for input   {wait:>9.2f}s  "
+              f"({100 * wait / wall:5.1f}%)"
+              f"   <- starvation: ingest too slow")
+        print(f"  dispatch            {disp:>9.2f}s  "
+              f"({100 * disp / wall:5.1f}%)"
+              f"   <- enqueue + device backpressure")
+        print(f"  other               {other:>9.2f}s  "
+              f"({100 * other / wall:5.1f}%)   <- logging/validation/save")
+        verdict = (
+            "INGEST-BOUND (grow thread_num/parse_processes, or "
+            "cache_epochs)"
+            if frac > 0.25 else "compute-bound (ingest keeps up)"
+        )
+        print(f"  ingest_wait_frac    {frac:>9.3f}    -> {verdict}")
+    else:
+        print(f"\nserving run ({kind} record, checkpoint step "
+              f"{rec.get('step', '?')}, {wall:.1f}s up)")
     for key in ("truncated_features", "out_of_range_batches",
                 "ingest_cache", "examples_in"):
         if key in rec:
@@ -202,6 +214,22 @@ def _print_breakdown(rec: dict) -> None:
     else:
         print("\nmemory & compile: n/a (stream has no resource block — "
               "pre-resource run or resource_metrics=off)")
+    serve = rec.get("serve")
+    if serve:
+        print("\nserving (latency under load):")
+        for key in ("requests", "examples", "batches", "qps",
+                    "p50_ms", "p95_ms", "p99_ms", "max_ms",
+                    "batch_fill", "swaps", "compiles",
+                    "steady_compiles", "recompiles_unexpected"):
+            if key in serve:
+                print(f"  {key:22s} {serve[key]}")
+        if serve.get("steady_compiles"):
+            print("  !! compiles happened AFTER warmup — a request "
+                  "shape escaped the serve_batch_sizes ladder (a "
+                  "multi-second latency cliff on the hot path)")
+    else:
+        print("\nserving: n/a (stream has no serve block — training "
+              "run or pre-serve stream)")
     tiered = rec.get("tiered") or {}
     if tiered:
         print("\ntiered embedding table (hot/cold migration):")
@@ -268,6 +296,12 @@ def _print_compiles(compiles: list) -> None:
         flag = "" if c.get("expected", True) else "  << UNEXPECTED"
         flops = c.get("flops")
         extra = f"  {flops:.3g} flops" if flops else ""
+        if c.get("where") == "serve":
+            # Serving-ladder compile: identified by rung shape, not a
+            # training step.
+            print(f"  serve shape {str(c.get('shape', '?')):>10} "
+                  f"{c.get('compile_s', 0.0):7.2f}s{flag}")
+            continue
         print(f"  step {c.get('step', '?'):>6}  k={c.get('k', '?'):<4} "
               f"{c.get('compile_s', 0.0):7.2f}s{extra}{flag}")
 
@@ -763,6 +797,17 @@ _DIRECTION_OVERRIDES = {
     "model_flops_per_s": "high", "resource.model_flops_per_s": "high",
     "resource.compiles": None,
     "resource_overhead": "low",
+    # Serving path (PR 9): tail latency regresses when it RISES (the
+    # _ms suffix already says so; bench keys listed for clarity),
+    # throughput and batch fill when they FALL; any compile after
+    # warmup is a latency cliff.  Bare spellings gate bench JSONs,
+    # `serve.`-prefixed ones the flattened metrics-stream block.
+    "serve_p50_ms": "low", "serve_p99_ms": "low",
+    "serve_qps": "high", "serve.qps": "high",
+    "serve_batch_fill": "high", "serve.batch_fill": "high",
+    "serve_steady_compiles": "low", "serve.steady_compiles": "low",
+    "serve.recompiles_unexpected": "low",
+    "serve.requests": None, "serve.swaps": None, "serve.compiles": None,
 }
 
 
@@ -826,6 +871,15 @@ def _comparable_metrics(path: str) -> dict:
         val = (final.get("resource") or {}).get(key)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             out[f"resource.{key}"] = float(val)
+    # Serving block (PR 9): latency/throughput axes of a serve stream.
+    # Training streams carry no serve block and contribute no serve.*
+    # keys — same shared-set back-compat as the resource block.
+    for key in ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_fill",
+                "requests", "swaps", "compiles", "steady_compiles",
+                "recompiles_unexpected"):
+        val = (final.get("serve") or {}).get(key)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[f"serve.{key}"] = float(val)
     if "trace_dropped_events" in final:
         out["trace_dropped_events"] = float(final["trace_dropped_events"])
     # Watchdog output: total fires, halts, and per-rule counts — all

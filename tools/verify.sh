@@ -27,11 +27,15 @@ if [ "${1:-}" = "--audit" ]; then
 fi
 
 echo
-echo "== live observability smoke (tools/obs_smoke.py) =="
+echo "== live observability + serving smoke (tools/obs_smoke.py) =="
 # A real CLI run with --status_port: /metrics must serve parseable
 # Prometheus text (incl. the resource block + tffm_build_info) and
 # /status the heartbeat JSON, mid-run; /debug/threadz must dump every
 # thread; /profile must capture once and 409 a concurrent request.
+# Then the serve smoke against the checkpoint that run wrote:
+# run_tffm.py serve must score over the socket, expose tffm_serve_*
+# on /metrics, and hot-swap once when a second training run
+# republishes the checkpoint manifest.
 JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 
 echo
