@@ -894,6 +894,27 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 - preflight must not sink bench
         tier1_audit = {"ok": False, "problems": [f"audit failed: {e}"]}
 
+    # Preflight: the full static-analysis suite (tools/lint — tier-1
+    # audit is one of its rules, but the bench JSON keeps tier1_audit
+    # as its own back-compat block).  lint_findings_new is a gated
+    # --compare key: a PR that introduces a new finding regresses the
+    # bench trajectory exactly like a perf key (direction: low).
+    lint_findings_new = None
+    lint_findings_baselined = None
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tools import lint as lint_mod
+
+        lr = lint_mod.run(root=repo)
+        lint_findings_new = (
+            len(lr["new"]) + len(lr["stale"]) + len(lr["uncommented"])
+        )
+        lint_findings_baselined = len(lr["baselined"])
+    except Exception as e:  # noqa: BLE001 - preflight must not sink bench
+        print(f"lint preflight failed: {e}", file=sys.stderr)
+
     watchdog_note = None
     if not os.environ.get("BENCH_CHILD") and not os.environ.get(
         "BENCH_FORCE_CPU"
@@ -1348,6 +1369,11 @@ def main() -> int:
                 result[key] = serve_section[key]
     if tier1_audit is not None:
         result["tier1_audit"] = tier1_audit
+    if lint_findings_new is not None:
+        # Numeric top-level keys flow into --compare automatically;
+        # 0 -> N flags as a REGRESSION (direction: low in report.py).
+        result["lint_findings_new"] = lint_findings_new
+        result["lint_findings_baselined"] = lint_findings_baselined
     if ladder_rung is not None:
         result["ladder_rung"] = ladder_rung
     if ladder_errors:

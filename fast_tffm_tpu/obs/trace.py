@@ -135,17 +135,19 @@ class Tracer:
         # cap anywhere near the watermark would drop events rotation
         # exists to preserve (a worker-shipped batch crossing the cap
         # used to truncate before the rotation check could run).
-        self._rotate_events = int(rotate_events or 0)
+        # _rotate_cfg is the configured watermark (never changes);
+        # _rotate_events is the LIVE value — close() zeroes it so
+        # post-close stragglers fall back to the capped buffer, and
+        # reset() re-arms it for the next run of a warm owner.
+        self._rotate_cfg = int(rotate_events or 0)
+        self._rotate_events = self._rotate_cfg
         self._rotate_path = rotate_path
         self._windows = 0
         self._dropped_reported = 0
         self._rotate_q: Optional[queue.Queue] = None
+        self._rotate_thread: Optional[threading.Thread] = None
         if self._rotate_events and enabled:
-            self._rotate_q = queue.Queue()
-            threading.Thread(
-                target=self._writer_loop, name="trace-rotate",
-                daemon=True,
-            ).start()
+            self._start_writer()
         self._pid = os.getpid()
         self._named_tids: set = set()
         self._process_name = process_name
@@ -323,30 +325,46 @@ class Tracer:
             return f"{stem}.{idx}.json"
         return f"{base}.{idx}.json"
 
+    def _start_writer(self) -> None:
+        self._rotate_q = queue.Queue()
+        self._rotate_thread = threading.Thread(
+            target=self._writer_loop, name="trace-rotate", daemon=True,
+        )
+        self._rotate_thread.start()
+
     def _writer_loop(self) -> None:
+        q = self._rotate_q  # bound once: close() clears the attribute
         while True:
-            item = self._rotate_q.get()
+            item = q.get()
             try:
                 if item is None:
                     return
                 self._write_window(*item)
             finally:
-                self._rotate_q.task_done()
+                q.task_done()
 
     def _maybe_rotate(self) -> None:
         """Swap the full buffer out under the lock and enqueue it for
         the writer thread.  Instrumented threads only ever pay the
         swap; the file write happens off the hot path.  A losing racer
-        sees the already-reset buffer and returns."""
+        sees the already-reset buffer and returns.  The queue is
+        captured UNDER the lock (close() clears it under the same
+        lock), so a racing close() can never strand swapped-out events
+        on a writerless queue or null-deref here."""
         with self._lock:
-            if len(self._events) < self._rotate_events:
-                return  # lost the race; the buffer already rotated
+            q = self._rotate_q
+            if (
+                q is None
+                or not self._rotate_events
+                or len(self._events) < self._rotate_events
+            ):
+                return  # lost the race (rotation closed or buffer reset)
             events, self._events = self._events, []
             idx = self._windows
             self._windows += 1
             dropped = self._dropped - self._dropped_reported
             self._dropped_reported = self._dropped
-        self._rotate_q.put((idx, events, dropped))
+        q.put((idx, events, dropped))
 
     def _write_window(self, idx: int, events: list,
                       dropped: int) -> None:
@@ -376,6 +394,29 @@ class Tracer:
     # lifecycle
     # ------------------------------------------------------------------
 
+    def close(self) -> None:
+        """Stop the rotation writer thread (idempotent; no-op without
+        rotation).  Pending windows are flushed first.  A Tracer used
+        to be leaked-by-design here — every rotating Tracer left a
+        daemon ``trace-rotate`` thread alive for the life of the
+        process, one more per run in a long-lived embedder (serve
+        mode, test suites); flagged by tffm-lint TL005."""
+        with self._lock:
+            # Cleared under the append lock so a racing _maybe_rotate
+            # either sees the live queue (its window will be drained by
+            # the q.join() below) or sees None and backs off — never a
+            # swap onto a writerless queue.  Post-close stragglers fall
+            # back to the capped in-memory buffer; reset() re-arms.
+            q = self._rotate_q
+            self._rotate_events = 0
+            self._rotate_q = None
+        if q is not None:
+            q.join()
+            q.put(None)
+        if self._rotate_thread is not None:
+            self._rotate_thread.join()
+            self._rotate_thread = None
+
     def reset(self) -> None:
         """Drop buffered events and re-anchor (per-run accounting, like
         Telemetry.reset).  The process-name metadata survives — it names
@@ -393,6 +434,12 @@ class Tracer:
             self._named_tids = set()
         self._wall_anchor = time.time()
         self._perf_anchor = time.perf_counter()
+        # A close()d tracer re-arms for the next run: a warm owner's
+        # second train() must rotate exactly like the first (close()
+        # only stops the PREVIOUS run's writer thread).
+        if self._rotate_cfg and self.enabled and self._rotate_q is None:
+            self._rotate_events = self._rotate_cfg
+            self._start_writer()
         if self.enabled and self._process_name:
             self.name_process(self._process_name)
 
@@ -408,17 +455,19 @@ class Tracer:
         ``tools/report.py --trace`` can place traces from different
         hosts (multi-rank runs) on one wall-clock timeline.
         """
-        if self._rotate_events and self._rotate_q is not None:
-            with self._lock:
+        with self._lock:
+            q = self._rotate_q if self._rotate_events else None
+            if q is not None:
                 events, self._events = self._events, []
                 idx = self._windows
                 self._windows += 1
                 dropped = self._dropped - self._dropped_reported
                 self._dropped_reported = self._dropped
-            self._rotate_q.put((idx, events, dropped))
+        if q is not None:
+            q.put((idx, events, dropped))
             # End of run: every window must be on disk when dump
             # returns (the caller logs the family and may exit).
-            self._rotate_q.join()
+            q.join()
             return len(events)
         with self._lock:
             events = list(self._events)

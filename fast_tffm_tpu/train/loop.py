@@ -1923,6 +1923,9 @@ class Trainer:
                         )
                 except OSError as e:  # pragma: no cover - full volume
                     log.warning("trace dump failed: %s", e)
+                # Stop the rotation writer thread (idempotent; no-op
+                # without rotation) — each run used to leak one.
+                self.tracer.close()
         train_metrics = _finalize_metrics(self.state.metrics, cfg.loss_type)
         train_metrics["examples_per_sec"] = (
             train_metrics["examples"] / max(time.time() - t0, 1e-9)

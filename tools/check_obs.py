@@ -22,7 +22,9 @@ tools/check_tier1.py):
   backticked cell of each row is the metric name);
 - fail (exit 1) listing every name on one side only.
 
-Run directly, or via ``tools/verify.sh`` (wired into the audit step).
+Run directly, or as rules OB001/OB002 of the tffm-lint suite
+(``python -m tools.lint``, which tools/verify.sh runs — see
+LINTING.md).
 """
 
 from __future__ import annotations

@@ -12,6 +12,9 @@ can run it as a preflight and CI can gate on it:
   python tools/check_tier1.py            # audit ./tests, exit 1 on drift
   python tools/check_tier1.py --list     # per-file tier-1/slow counts
 
+(Also runs as rule T1001 of the tffm-lint suite — ``python -m
+tools.lint``, the tools/verify.sh entry point; see LINTING.md.)
+
 Checks:
   1. every ``tests/test_*.py`` defines at least one test;
   2. every test file keeps at least one tier-1 (non-slow) test — no

@@ -1,0 +1,130 @@
+"""CLI for tffm-lint.
+
+::
+
+    python -m tools.lint                  # all rules, default baseline
+    python -m tools.lint --list-rules     # rule catalog
+    python -m tools.lint --show-baselined # include grandfathered finds
+    python -m tools.lint --write-baseline # bootstrap/refresh baseline
+    python -m tools.lint --no-baseline    # raw findings (exit 1 on any)
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings
+(or a malformed baseline: stale entries and entries without a reason
+comment fail too — a baseline is a burn-down list, not a mute button).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# `python tools/lint/__main__.py` (path form) lacks the repo root on
+# sys.path; `python -m tools.lint` has it.  Support both.
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools import lint  # noqa: E402
+from tools.lint.core import Context, load_baseline, run_rules  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="fast_tffm_tpu static-analysis suite "
+                    "(rule catalog: LINTING.md)",
+    )
+    ap.add_argument("--root", default=_REPO,
+                    help="repo root (default: this checkout)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default "
+                         f"<root>/{lint.DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report and fail on "
+                         "every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write every current finding to the baseline "
+                         "file (entries still need a reason comment "
+                         "added by hand)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print grandfathered findings")
+    ap.add_argument("--rules", default=None, metavar="NAMES",
+                    help="comma-separated rule names to run "
+                         "(default: all; see --list-rules)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = lint.default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:12} {', '.join(r.rule_ids)}")
+        return 0
+    if args.rules:
+        wanted = {w.strip() for w in args.rules.split(",")}
+        rules = [r for r in rules if r.name in wanted]
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    ctx = Context(args.root)
+    baseline_path = args.baseline or os.path.join(
+        ctx.root, lint.DEFAULT_BASELINE
+    )
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    if args.rules:
+        # A subset run can only see its own rules' findings — entries
+        # for unselected rules are invisible, not stale.
+        selected_ids = {i for r in rules for i in r.rule_ids}
+        baseline = {
+            k: v for k, v in baseline.items()
+            if k.split(":", 1)[0] in selected_ids
+        }
+    result = run_rules(rules, ctx, baseline)
+
+    if args.write_baseline:
+        with open(baseline_path, "w") as f:
+            f.write(
+                "# tffm-lint baseline: grandfathered findings "
+                "(LINTING.md).\n"
+                "# One key per line; EVERY entry needs a trailing "
+                "'# reason'.\n"
+                "# Burn entries down — a fixed finding shows up as "
+                "'stale' and fails the run.\n"
+            )
+            for fnd in result["findings"]:
+                comment = baseline.get(fnd.key, "")
+                f.write(f"{fnd.key}  # {comment}\n")
+        print(f"wrote {len(result['findings'])} finding key(s) to "
+              f"{baseline_path} — add a reason after each '#'")
+        return 0
+
+    for fnd in result["new"]:
+        print(fnd.render())
+    if args.show_baselined:
+        for fnd in result["baselined"]:
+            print(fnd.render(baselined=True))
+    problems = len(result["new"])
+    for key in result["stale"]:
+        print(f"stale baseline entry (fixed? remove the line): {key}")
+    for key in result["uncommented"]:
+        print(f"baseline entry without a reason comment: {key}")
+    n_rules = sum(len(r.rule_ids) for r in rules)
+    print(
+        f"tffm-lint: {len(rules)} analyzers ({n_rules} rule ids), "
+        f"{len(result['findings'])} finding(s) "
+        f"({len(result['baselined'])} baselined, "
+        f"{len(result['new'])} new), "
+        f"{len(result['stale'])} stale baseline entr(ies)"
+    )
+    if problems or result["stale"] or result["uncommented"]:
+        return 1
+    print("ok: no new findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,254 @@
+"""tffm-lint core: the shared AST-walk framework every analyzer rides.
+
+The repo's hardest bugs have been *invariant violations no test caught
+until a reviewer did* (the PR 6 single-device ``device_put`` aliasing
+hazard, the PR 7 tracer drop-cap truncation, silently-inert
+``alert_rules``).  Each analyzer in this package makes one of those
+review checklists mechanical.  The framework's jobs:
+
+- parse every package source ONCE (:class:`Context` caches trees);
+- represent results uniformly (:class:`Finding`: file:line + rule id +
+  message + fix hint + a line-number-free ``key`` for baselining);
+- suppress grandfathered findings via a ``--baseline`` file so NEW
+  violations fail while old ones burn down;
+- honor inline ``# lint: disable=RULE`` comments on the flagged line
+  (for the rare sanctioned exception that deserves to live next to the
+  code it excuses, e.g. the probe-gated staging pool).
+
+Everything is stdlib-only, static (no imports of the package under
+analysis), and runs in milliseconds — the same discipline as the two
+ancestors it grew from (tools/check_tier1.py, tools/check_obs.py),
+which are folded in as rules T1001/OB001-OB002.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Optional
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one site.
+
+    ``symbol`` is the stable identity used for baselining (a qualified
+    name like ``ClassName.attr`` — never a line number, so baselines
+    survive unrelated edits to the file above the finding).
+    """
+
+    rule: str      # e.g. "TL001"
+    path: str      # repo-relative
+    line: int
+    message: str
+    hint: str = ""
+    symbol: str = ""
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: rule + path + symbol (no line numbers)."""
+        sym = self.symbol or re.sub(r"\s+", "-", self.message)[:80]
+        return f"{self.rule}:{self.path}:{sym}"
+
+    def render(self, baselined: bool = False) -> str:
+        tag = " [baselined]" if baselined else ""
+        hint = f"  (fix: {self.hint})" if self.hint else ""
+        return f"{self.path}:{self.line}: {self.rule}{tag} " \
+               f"{self.message}{hint}"
+
+
+class Context:
+    """One lint run's view of the repo: file discovery + parse cache.
+
+    Paths are configurable so tests can point the same rules at a
+    fixture tree (a miniature repo with its own config.py / cli.py /
+    OBSERVABILITY.md) instead of the live one.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        pkg: str = "fast_tffm_tpu",
+        tests_dir: str = "tests",
+        obs_md: str = "OBSERVABILITY.md",
+        doc_files: tuple = ("README.md", "OBSERVABILITY.md",
+                            "SERVING.md", "INGEST.md", "EMBEDDING.md",
+                            "QUALITY.md", "LINTING.md"),
+        extra_files: tuple = (),
+    ):
+        self.root = os.path.abspath(root)
+        self.pkg = pkg
+        self.tests_dir = tests_dir
+        self.obs_md = obs_md
+        self.doc_files = doc_files
+        self.extra_files = tuple(extra_files)
+        self._trees: dict = {}
+        self._sources: dict = {}
+
+    # -- file discovery ------------------------------------------------
+
+    def package_files(self) -> list:
+        """Repo-relative paths of every package ``.py`` source, plus any
+        ``extra_files`` (fixture snippets in tests)."""
+        out = []
+        pkg_dir = os.path.join(self.root, self.pkg)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fname), self.root
+                    ))
+        out.extend(self.extra_files)
+        return out
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(self.abspath(rel))
+
+    # -- parse cache ---------------------------------------------------
+
+    def source(self, rel: str) -> str:
+        if rel not in self._sources:
+            with open(self.abspath(rel)) as f:
+                self._sources[rel] = f.read()
+        return self._sources[rel]
+
+    def tree(self, rel: str) -> Optional[ast.AST]:
+        """Parsed AST for one file (None on syntax error — an
+        unparsable source is its own, louder problem)."""
+        if rel not in self._trees:
+            try:
+                self._trees[rel] = ast.parse(
+                    self.source(rel), filename=rel
+                )
+            except SyntaxError:
+                self._trees[rel] = None
+        return self._trees[rel]
+
+    def line_disables(self, rel: str, line: int) -> set:
+        """Rule ids named by a ``# lint: disable=R1,R2`` comment on
+        ``line`` (1-indexed) of ``rel``."""
+        try:
+            text = self.source(rel).splitlines()[line - 1]
+        except IndexError:
+            return set()
+        m = re.search(r"#\s*lint:\s*disable=([\w,]+)", text)
+        return set(m.group(1).split(",")) if m else set()
+
+
+# ---------------------------------------------------------------------
+# shared AST helpers (used by several analyzers)
+# ---------------------------------------------------------------------
+
+def call_name(node: ast.AST) -> str:
+    """Terminal name of a call target: ``jax.jit`` -> ``jit``,
+    ``Thread`` -> ``Thread``, anything else -> ''."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def recv_repr(node: ast.AST) -> str:
+    """Canonical text of a simple receiver chain (``self._lock``,
+    ``work``); '' for anything more complex."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = recv_repr(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def function_scopes(tree: ast.AST) -> list:
+    """Every function in the module as ``(qualname, node)``, methods
+    qualified by their class.  Each scope's body is analyzed
+    independently; nested defs become their own scopes."""
+    out = []
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                out.append((qual, child))
+                visit(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def walk_scope(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk limited to one function scope: descends everything
+    EXCEPT nested function/class bodies (they are separate scopes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+# ---------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------
+
+def load_baseline(path: str) -> dict:
+    """{finding-key: comment} from a baseline file.  Line format::
+
+        RULE:path:symbol  # why this finding is grandfathered
+
+    Full-line ``#`` comments and blanks are ignored.  Every entry MUST
+    carry a trailing comment — a baseline without a reason is just a
+    muted alarm (enforced by the CLI, warned here)."""
+    out: dict = {}
+    if not path or not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, _, comment = line.partition("#")
+            out[key.strip()] = comment.strip()
+    return out
+
+
+def run_rules(rules, ctx: Context, baseline: Optional[dict] = None) -> dict:
+    """Run every rule; classify findings against the baseline.
+
+    Returns ``{findings, new, baselined, stale, uncommented}`` where
+    ``stale`` lists baseline keys no current finding matches (burn the
+    entry down) and ``uncommented`` baseline keys with no reason."""
+    baseline = baseline or {}
+    findings: list = []
+    for rule in rules:
+        for f in rule.run(ctx):
+            if f.rule in ctx.line_disables(f.path, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    seen_keys = set()
+    new, baselined = [], []
+    for f in findings:
+        seen_keys.add(f.key)
+        (baselined if f.key in baseline else new).append(f)
+    stale = sorted(set(baseline) - seen_keys)
+    uncommented = sorted(
+        k for k, comment in baseline.items() if not comment
+    )
+    return {
+        "findings": findings, "new": new, "baselined": baselined,
+        "stale": stale, "uncommented": uncommented,
+    }

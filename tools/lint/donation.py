@@ -1,0 +1,234 @@
+"""DA00x — donation / aliasing discipline analyzer.
+
+Two hazard classes, both drawn from shipped incidents:
+
+DA001 **use-after-donate**: an argument at a ``donate_argnums``
+position of a jitted callable is dead the moment the call dispatches —
+XLA may reuse its buffer for the output.  Reading the donated name
+afterwards (without rebinding it to the call's result) is the
+classic silent-corruption bug.  The analyzer records every
+``X = jax.jit(fn, donate_argnums=...)`` binding (constant argnums
+only), then flags call sites where a donated positional arg's name is
+read again later in the same function without an intervening rebind.
+
+DA002 **device_put alias-write**: on single-device CPU,
+``jax.device_put`` ALIASES host memory instead of copying — writing to
+the host array afterwards corrupts the in-flight device value.  That
+is the PR 6 staging-pool hazard: recycled staging buffers were
+rewritten while a previous super-batch still read them, making
+1-device-CPU training nondeterministic until ``_StagingPool`` grew a
+probe-on-first-retire gate.  The analyzer flags any name handed to
+``device_put`` and LATER written in the same scope (subscript store,
+augmented assign, ``.fill()``, ``np.copyto``).  Writes that go through
+the probe-gated staging pool are the sanctioned exception — suppress
+with ``# lint: disable=DA002`` next to the probe gate, where a reader
+will find the justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import (
+    Context, Finding, call_name, function_scopes, recv_repr, walk_scope,
+)
+
+
+def _const_argnums(kw_value) -> tuple:
+    """donate_argnums constant indices, or None when not static."""
+    if isinstance(kw_value, ast.Constant) and isinstance(
+        kw_value.value, int
+    ):
+        return (kw_value.value,)
+    if isinstance(kw_value, (ast.Tuple, ast.List)):
+        out = []
+        for e in kw_value.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _donating_bindings(tree) -> dict:
+    """{terminal-name: argnums} for every ``X = jax.jit(...,
+    donate_argnums=CONST)`` binding in the module (X a Name or a
+    ``self.X`` attribute; matching at call sites is by terminal
+    name)."""
+    out = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        val = node.value
+        if not (
+            isinstance(val, ast.Call) and call_name(val.func) in
+            ("jit", "pjit")
+        ):
+            continue
+        argnums = None
+        for kw in val.keywords:
+            if kw.arg == "donate_argnums":
+                argnums = _const_argnums(kw.value)
+        if not argnums:
+            continue
+        tgt = node.targets[0]
+        name = (
+            tgt.id if isinstance(tgt, ast.Name)
+            else tgt.attr if isinstance(tgt, ast.Attribute)
+            else None
+        )
+        if name:
+            out[name] = argnums
+    return out
+
+
+def _name_events(fn, target: str):
+    """(line, kind) events for ``target`` in one scope: kind is
+    'load' or 'store'.  ``target`` is a canonical receiver string
+    (``x`` or ``self.x``)."""
+    events = []
+    for node in walk_scope(fn):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if recv_repr(node) != target:
+                continue
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, ast.Store):
+                events.append((node.lineno, "store"))
+            elif isinstance(ctx, ast.Load):
+                events.append((node.lineno, "load"))
+    return sorted(events)
+
+
+class DonationRule:
+    name = "donation"
+    rule_ids = ("DA001", "DA002")
+
+    def run(self, ctx: Context):
+        findings = []
+        for rel in ctx.package_files():
+            tree = ctx.tree(rel)
+            if tree is None:
+                continue
+            donating = _donating_bindings(tree)
+            for qual, fn in function_scopes(tree):
+                if donating:
+                    findings.extend(self._check_donate_calls(
+                        rel, qual, fn, donating
+                    ))
+                findings.extend(self._check_device_put(rel, qual, fn))
+        return findings
+
+    # -- DA001 ---------------------------------------------------------
+
+    def _check_donate_calls(self, rel, qual, fn, donating):
+        findings = []
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            argnums = donating.get(call_name(node.func))
+            if not argnums:
+                continue
+            for i in argnums:
+                if i >= len(node.args):
+                    continue
+                arg = node.args[i]
+                target = recv_repr(arg)
+                if not target:
+                    continue
+                events = _name_events(fn, target)
+                # The donated value is dead after the CALL (its
+                # end_lineno — a multiline call's own argument lines
+                # are not "later" reads); the FIRST later event must
+                # be a rebind (store), not a read.  (Stores on the
+                # call's own lines cover the idiomatic
+                # ``state = step(state, ...)``.)
+                end = getattr(node, "end_lineno", node.lineno)
+                later = [e for e in events if e[0] > end]
+                same_line_store = any(
+                    node.lineno <= ln <= end and k == "store"
+                    for ln, k in events
+                )
+                if same_line_store:
+                    continue
+                if later and later[0][1] == "load":
+                    findings.append(Finding(
+                        rule="DA001", path=rel, line=later[0][0],
+                        message=(
+                            f"`{target}` is read after being donated "
+                            f"to `{call_name(node.func)}` (donate_"
+                            f"argnums position {i}, call at line "
+                            f"{node.lineno}) — XLA may have reused "
+                            "its buffer"
+                        ),
+                        hint="rebind the name to the call's result, "
+                             "or stop donating that argument",
+                        symbol=f"{qual}.{target}",
+                    ))
+        return findings
+
+    # -- DA002 ---------------------------------------------------------
+
+    def _check_device_put(self, rel, qual, fn):
+        findings = []
+        put_names = {}  # target -> device_put call line
+        for node in walk_scope(fn):
+            if (
+                isinstance(node, ast.Call)
+                and call_name(node.func) == "device_put"
+                and node.args
+            ):
+                target = recv_repr(node.args[0])
+                if target:
+                    put_names.setdefault(
+                        target, getattr(node, "end_lineno", node.lineno)
+                    )
+        if not put_names:
+            return findings
+        for node in walk_scope(fn):
+            write_line = None
+            target = None
+            # arr[...] = v
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        target = recv_repr(tgt.value)
+                        write_line = tgt.lineno
+            # arr += v / arr[...] += v
+            elif isinstance(node, ast.AugAssign):
+                tgt = node.target
+                if isinstance(tgt, ast.Subscript):
+                    target = recv_repr(tgt.value)
+                else:
+                    target = recv_repr(tgt)
+                write_line = tgt.lineno
+            # arr.fill(v) / np.copyto(arr, v)
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "fill"
+                ):
+                    target = recv_repr(node.func.value)
+                    write_line = node.lineno
+                elif call_name(node.func) == "copyto" and node.args:
+                    target = recv_repr(node.args[0])
+                    write_line = node.lineno
+            if (
+                target in put_names
+                and write_line is not None
+                and write_line > put_names[target]
+            ):
+                findings.append(Finding(
+                    rule="DA002", path=rel, line=write_line,
+                    message=(
+                        f"host array `{target}` was handed to "
+                        f"device_put (line {put_names[target]}) and is "
+                        "written here — on single-device backends "
+                        "device_put ALIASES host memory, so this "
+                        "corrupts the in-flight device value"
+                    ),
+                    hint="route the reuse through a probe-gated pool "
+                         "(see _StagingPool) or copy before the write",
+                    symbol=f"{qual}.{target}",
+                ))
+        return findings

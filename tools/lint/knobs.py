@@ -1,0 +1,311 @@
+"""KD00x — knob drift analyzer: config fields, the INI parse surface,
+the CLI parser, the docs, and the run-header fingerprint must agree.
+
+The shipped incident behind this rule: ``alert_rules`` (and later the
+resource-aliased rules) could be configured while the plane that
+evaluates them was off — a knob that LOOKS set but is silently inert.
+The same drift class appears every time a field is added to
+``config.py`` without its INI key, or a ``--flag`` is added to cli.py
+without its entry in the overrides tuple (the flag parses and then
+falls on the floor).
+
+Checks (all static; config.py and cli.py are parsed, never imported):
+
+- KD001  FmConfig field has no INI key in ``_KEYMAP`` (the knob cannot
+         be set from a cfg file);
+- KD002  ``_KEYMAP`` entry names a nonexistent field (typo — the key
+         parses into a constructor TypeError at load time);
+- KD003  an argparse ``--flag`` whose dest IS a config field never
+         appears in the CLI override plumbing (the flag parses, then
+         its value is dropped — a silently-inert CLI surface);
+- KD004  an override key that is not a config field (getattr/
+         constructor blowup waiting for the first use);
+- KD005  a config field mentioned in none of the repo docs (README /
+         OBSERVABILITY / SERVING / INGEST / EMBEDDING / ...);
+- KD006  a knob row in OBSERVABILITY.md's "## Knobs" table that names
+         a nonexistent field or CLI flag (docs drifted ahead of code);
+- KD007  the run-header fingerprint does not cover the full config
+         (``_config_fingerprint`` must hash ``dataclasses.asdict`` of
+         the WHOLE dataclass, or explicitly name every field) — a
+         fingerprint that skips a knob lets two incomparable runs
+         claim comparability.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.core import Context, Finding
+
+_NON_CONFIG_DESTS = {
+    # distributed-launch / legacy flags — not config knobs by design
+    "coordinator", "num_processes", "process_id",
+    "ps_hosts", "worker_hosts", "job_name", "task_index",
+}
+
+
+def _config_fields(tree) -> dict:
+    """{field: lineno} of FmConfig dataclass AnnAssign fields."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "FmConfig":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    out[stmt.target.id] = stmt.lineno
+    return out
+
+
+def _keymap(tree) -> dict:
+    """{ini-key: (field, lineno)} from the ``_KEYMAP`` dict literal."""
+    out = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(isinstance(t, ast.Name) and t.id == "_KEYMAP"
+                    for t in node.targets)
+            and isinstance(node.value, ast.Dict)
+        ):
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                field = None
+                if isinstance(v, ast.Tuple) and v.elts and isinstance(
+                    v.elts[0], ast.Constant
+                ):
+                    field = v.elts[0].value
+                out[k.value] = (field, k.lineno)
+    return out
+
+
+def _cli_surface(tree):
+    """(flags {--flag: (dest, lineno)}, override_mentions set).
+
+    ``override_mentions`` is every string constant that appears inside
+    a tuple/list literal or as a subscript-store key in cli.py — the
+    two idioms the override plumbing uses (the big overrides tuple and
+    ``overrides["telemetry"] = False``-style special cases)."""
+    flags = {}
+    mentions = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+        ):
+            dest = None
+            for kw in node.keywords:
+                if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                    dest = kw.value.value
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ) and arg.value.startswith("--"):
+                    d = dest or arg.value.lstrip("-").replace("-", "_")
+                    flags[arg.value] = (d, arg.lineno)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                    e.value, str
+                ):
+                    mentions.add(e.value)
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.slice, ast.Constant)
+                    and isinstance(tgt.slice.value, str)
+                ):
+                    mentions.add(tgt.slice.value)
+    return flags, mentions
+
+
+_KNOB_ROW = re.compile(r"^\|([^|]*)\|")
+_BACKTICK = re.compile(r"`([^`]+)`")
+
+
+def _knob_table(md_text: str):
+    """Rows of the ``## Knobs`` table: (knob, [cli spellings], lineno)."""
+    rows = []
+    in_section = False
+    for lineno, line in enumerate(md_text.splitlines(), 1):
+        stripped = line.strip()
+        if stripped.startswith("## "):
+            in_section = stripped.startswith("## Knobs")
+            continue
+        if not in_section:
+            continue
+        m = _KNOB_ROW.match(stripped)
+        if not m:
+            continue
+        names = _BACKTICK.findall(m.group(1))
+        if not names or names[0] in ("knob",):
+            continue
+        knob = names[0]
+        clis = [n.split()[0] for n in names[1:] if n.startswith("--")]
+        rows.append((knob, clis, lineno))
+    return rows
+
+
+class KnobsRule:
+    name = "knobs"
+    rule_ids = ("KD001", "KD002", "KD003", "KD004", "KD005", "KD006",
+                "KD007")
+
+    def run(self, ctx: Context):
+        findings = []
+        cfg_rel = f"{ctx.pkg}/config.py"
+        cli_rel = f"{ctx.pkg}/cli.py"
+        if not ctx.exists(cfg_rel):
+            return findings
+        cfg_tree = ctx.tree(cfg_rel)
+        if cfg_tree is None:
+            return findings
+        fields = _config_fields(cfg_tree)
+        keymap = _keymap(cfg_tree)
+        keymap_fields = {f for f, _ in keymap.values() if f}
+
+        # KD001 / KD002
+        for field, line in sorted(fields.items()):
+            if field not in keymap_fields:
+                findings.append(Finding(
+                    rule="KD001", path=cfg_rel, line=line,
+                    message=f"config field `{field}` has no INI key in "
+                            "_KEYMAP — it cannot be set from a cfg file",
+                    hint=f'add `"{field}": ("{field}", <parser>)` to '
+                         "_KEYMAP",
+                    symbol=field,
+                ))
+        for key, (field, line) in sorted(keymap.items()):
+            if field and field not in fields:
+                findings.append(Finding(
+                    rule="KD002", path=cfg_rel, line=line,
+                    message=f"_KEYMAP entry `{key}` maps to nonexistent "
+                            f"field `{field}`",
+                    hint="fix the field name (this key raises TypeError "
+                         "at load time)",
+                    symbol=key,
+                ))
+
+        # KD003 / KD004 against cli.py
+        flags = {}
+        if ctx.exists(cli_rel) and ctx.tree(cli_rel) is not None:
+            flags, mentions = _cli_surface(ctx.tree(cli_rel))
+            for flag, (dest, line) in sorted(flags.items()):
+                if dest in fields and dest not in mentions:
+                    findings.append(Finding(
+                        rule="KD003", path=cli_rel, line=line,
+                        message=(
+                            f"CLI flag `{flag}` parses into dest "
+                            f"`{dest}` but `{dest}` never appears in "
+                            "the override plumbing — the flag is "
+                            "silently inert"
+                        ),
+                        hint="add the dest to the overrides tuple in "
+                             "cli.main()",
+                        symbol=flag,
+                    ))
+            dests = {d for d, _ in flags.values()}
+            for mention in sorted(mentions):
+                if (
+                    mention in dests
+                    and mention not in fields
+                    and mention not in _NON_CONFIG_DESTS
+                ):
+                    findings.append(Finding(
+                        rule="KD004", path=cli_rel, line=1,
+                        message=(
+                            f"override key `{mention}` is plumbed from "
+                            "the CLI but is not an FmConfig field"
+                        ),
+                        hint="rename the key to a real field or add "
+                             "the field",
+                        symbol=mention,
+                    ))
+
+        # KD005: every field documented somewhere
+        doc_text = ""
+        for doc in ctx.doc_files:
+            if ctx.exists(doc):
+                doc_text += ctx.source(doc) + "\n"
+        for field, line in sorted(fields.items()):
+            if not re.search(rf"\b{re.escape(field)}\b", doc_text):
+                findings.append(Finding(
+                    rule="KD005", path=cfg_rel, line=line,
+                    message=f"config field `{field}` is mentioned in "
+                            "none of the repo docs",
+                    hint="document the knob (README or the subsystem "
+                         "doc that owns it)",
+                    symbol=field,
+                ))
+
+        # KD006: knobs table rows point at real code
+        if ctx.exists(ctx.obs_md):
+            for knob, clis, line in _knob_table(ctx.source(ctx.obs_md)):
+                if knob not in fields and knob not in keymap:
+                    findings.append(Finding(
+                        rule="KD006", path=ctx.obs_md, line=line,
+                        message=f"Knobs table row `{knob}` is not a "
+                                "config field or INI key",
+                        hint="fix the row or add the knob",
+                        symbol=knob,
+                    ))
+                for cli in clis:
+                    if flags and cli not in flags:
+                        findings.append(Finding(
+                            rule="KD006", path=ctx.obs_md, line=line,
+                            message=f"Knobs table names CLI spelling "
+                                    f"`{cli}` but cli.py defines no "
+                                    "such flag",
+                            hint="fix the spelling or add the flag",
+                            symbol=cli,
+                        ))
+
+        # KD007: fingerprint covers the full config
+        findings.extend(self._check_fingerprint(ctx, fields))
+        return findings
+
+    def _check_fingerprint(self, ctx, fields):
+        findings = []
+        for rel in ctx.package_files():
+            tree = ctx.tree(rel)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.FunctionDef)
+                    and node.name == "_config_fingerprint"
+                ):
+                    continue
+                uses_asdict = any(
+                    isinstance(sub, ast.Attribute)
+                    and sub.attr == "asdict"
+                    for sub in ast.walk(node)
+                )
+                if uses_asdict:
+                    return []
+                named = {
+                    sub.value for sub in ast.walk(node)
+                    if isinstance(sub, ast.Constant)
+                    and isinstance(sub.value, str)
+                } | {
+                    sub.attr for sub in ast.walk(node)
+                    if isinstance(sub, ast.Attribute)
+                }
+                for field in sorted(set(fields) - named):
+                    findings.append(Finding(
+                        rule="KD007", path=rel, line=node.lineno,
+                        message=(
+                            "_config_fingerprint enumerates fields but "
+                            f"omits `{field}` — two runs differing in "
+                            "it would fingerprint as comparable"
+                        ),
+                        hint="hash dataclasses.asdict(cfg) (covers "
+                             "every field forever) or add the field",
+                        symbol=field,
+                    ))
+                return findings
+        return findings

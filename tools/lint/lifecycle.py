@@ -1,0 +1,316 @@
+"""TL00x — thread/queue/SHM/HTTP-server lifecycle analyzer.
+
+Every concurrency resource the package creates must have a REACHABLE
+teardown on its owner's shutdown path:
+
+  ==============================  =========================
+  resource (constructor)          teardown (any of)
+  ==============================  =========================
+  ``threading.Thread``            ``join``
+  ``multiprocessing`` ``Process`` ``join`` / ``terminate``
+  ``_ClosableQueue``              ``cancel`` / ``close``
+  ``shared_memory.SharedMemory``  ``close`` / ``unlink``
+  ``ThreadingHTTPServer``         ``shutdown``
+  ==============================  =========================
+
+The class of leak this catches only shows at runtime today — the
+``test_ingest_matrix`` /dev/shm sweep finds orphaned segments, and a
+daemon thread that is never joined dies mid-write at interpreter exit
+(the PR 2 poll-free-shutdown work exists because of exactly that).
+``daemon=True`` does NOT excuse a missing join: daemon threads are the
+ones that get killed holding locks or half-written files.
+
+Ownership heuristics (deliberately conservative — transfer of
+ownership suppresses the finding, the baseline catches what slips
+through):
+
+- ``self.x = Thread(...)``: some method of the SAME class must call
+  ``self.x.join()`` (rule TL001; analogous ids per resource kind).
+- local ``t = Thread(...)``: the same function must call ``t.join()``,
+  unless the local is returned, stored on ``self``, appended into a
+  container, or passed to another callable (ownership moved).
+- ``threads = [Thread(...) ...]`` / ``threads += [...]`` /
+  ``lst.append(Thread(...))``: some loop/comprehension over that
+  container must call ``.join()`` on the loop variable.
+- ``threading.Thread(...).start()`` with the object never bound:
+  nothing can EVER join it — always a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import (
+    Context, Finding, call_name, function_scopes, recv_repr, walk_scope,
+)
+
+# constructor terminal name -> (rule id, kind, teardown attr names)
+_RESOURCES = {
+    "Thread": ("TL001", "thread", ("join",)),
+    "Process": ("TL001", "process", ("join", "terminate")),
+    "_ClosableQueue": ("TL002", "queue", ("cancel", "close")),
+    "SharedMemory": ("TL003", "SHM segment", ("close", "unlink")),
+    "ThreadingHTTPServer": ("TL004", "HTTP server", ("shutdown",)),
+    "HTTPServer": ("TL004", "HTTP server", ("shutdown",)),
+}
+
+
+def _ctor(node):
+    """(rule, kind, teardowns) when ``node`` constructs a tracked
+    resource, else None."""
+    if isinstance(node, ast.Call):
+        info = _RESOURCES.get(call_name(node.func))
+        if info:
+            return info
+    return None
+
+
+def _teardown_calls(node, teardowns):
+    """Receivers (canonical text) of ``X.join()``-style calls under
+    ``node`` — includes nested functions: a teardown is reachable from
+    a closure (``finally: ... join``) as much as from a method."""
+    out = set()
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in teardowns
+        ):
+            r = recv_repr(sub.func.value)
+            if r:
+                out.add(r)
+    return out
+
+
+def _container_teardown(node, container, teardowns) -> bool:
+    """True when ``node`` contains ``for t in <container>: t.join()``
+    (or a comprehension doing the same)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.For, ast.comprehension)):
+            it = sub.iter
+            tgt = sub.target
+            if recv_repr(it) != container:
+                continue
+            if not isinstance(tgt, ast.Name):
+                continue
+            body = sub.body if isinstance(sub, ast.For) else []
+            haystack = body or [sub]
+            for b in haystack:
+                for c in ast.walk(b if isinstance(b, ast.AST) else sub):
+                    if (
+                        isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Attribute)
+                        and c.func.attr in teardowns
+                        and isinstance(c.func.value, ast.Name)
+                        and c.func.value.id == tgt.id
+                    ):
+                        return True
+        # [t.join() for t in threads]
+        if isinstance(sub, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            gens = sub.generators
+            if (
+                gens
+                and recv_repr(gens[0].iter) == container
+                and isinstance(gens[0].target, ast.Name)
+                and isinstance(sub.elt, ast.Call)
+                and isinstance(sub.elt.func, ast.Attribute)
+                and sub.elt.func.attr in teardowns
+                and isinstance(sub.elt.func.value, ast.Name)
+                and sub.elt.func.value.id == gens[0].target.id
+            ):
+                return True
+    return False
+
+
+class LifecycleRule:
+    name = "lifecycle"
+    rule_ids = ("TL001", "TL002", "TL003", "TL004", "TL005")
+
+    def run(self, ctx: Context):
+        findings = []
+        for rel in ctx.package_files():
+            tree = ctx.tree(rel)
+            if tree is None:
+                continue
+            findings.extend(self._check_module(ctx, rel, tree))
+        return findings
+
+    # -----------------------------------------------------------------
+
+    def _check_module(self, ctx, rel, tree):
+        findings = []
+        # Class-attribute bindings: teardown must exist on the class.
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            torn = {}  # teardown attr receivers, computed lazily per kind
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                info = _ctor(node.value)
+                if info is None or len(node.targets) != 1:
+                    continue
+                tgt = node.targets[0]
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                rule, kind, teardowns = info
+                if teardowns not in torn:
+                    torn[teardowns] = _teardown_calls(cls, teardowns)
+                if f"self.{tgt.attr}" not in torn[teardowns]:
+                    findings.append(Finding(
+                        rule=rule, path=rel, line=node.value.lineno,
+                        message=(
+                            f"{kind} `self.{tgt.attr}` created in "
+                            f"{cls.name} has no reachable "
+                            f"{'/'.join(teardowns)} anywhere in the "
+                            "class"
+                        ),
+                        hint=(
+                            f"call `self.{tgt.attr}."
+                            f"{teardowns[0]}()` on the owner's "
+                            "close()/teardown path"
+                        ),
+                        symbol=f"{cls.name}.{tgt.attr}",
+                    ))
+            # Local bindings inside methods are handled by the
+            # function-scope pass below (function_scopes covers them).
+        # Function-scope locals + containers + unbound starts.
+        for qual, fn in function_scopes(tree):
+            findings.extend(self._check_scope(ctx, rel, qual, fn))
+        return findings
+
+    def _check_scope(self, ctx, rel, qual, fn):
+        findings = []
+        locals_: dict = {}      # name -> (line, rule, kind, teardowns)
+        containers: dict = {}   # container name -> (line, rule, kind, tds)
+        transferred: set = set()
+
+        # Pass 1: register bindings (walk order is arbitrary, so
+        # transfers are collected in a second pass once every local is
+        # known — `return cls(shm, ...)` transfers `shm` regardless of
+        # visit order).
+        for node in walk_scope(fn):
+            # t = Thread(...)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                info = _ctor(node.value)
+                tgt = node.targets[0]
+                if info and isinstance(tgt, ast.Name):
+                    locals_[tgt.id] = (node.value.lineno,) + info
+                    continue
+                # threads = [Thread(...), ...] / [... for _ in range(n)]
+                if isinstance(tgt, ast.Name) and isinstance(
+                    node.value, (ast.List, ast.ListComp)
+                ):
+                    elts = (
+                        node.value.elts
+                        if isinstance(node.value, ast.List)
+                        else [node.value.elt]
+                    )
+                    for e in elts:
+                        info = _ctor(e)
+                        if info:
+                            containers[tgt.id] = (e.lineno,) + info
+            # threads += [Thread(...) for ...]
+            if isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name
+            ) and isinstance(node.value, (ast.List, ast.ListComp)):
+                elts = (
+                    node.value.elts
+                    if isinstance(node.value, ast.List)
+                    else [node.value.elt]
+                )
+                for e in elts:
+                    info = _ctor(e)
+                    if info:
+                        containers[node.target.id] = (e.lineno,) + info
+            if isinstance(node, ast.Call):
+                # Thread(...).start() with the object never bound.
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "start"
+                    and _ctor(node.func.value)
+                ):
+                    rule, kind, teardowns = _ctor(node.func.value)
+                    findings.append(Finding(
+                        rule="TL005", path=rel,
+                        line=node.func.value.lineno,
+                        message=(
+                            f"{kind} started in {qual} without binding "
+                            "the object — nothing can ever "
+                            f"{'/'.join(teardowns)} it"
+                        ),
+                        hint="bind it to an attribute and tear it down "
+                             "with the owner",
+                        symbol=f"{qual}.<unbound>",
+                    ))
+                # lst.append(Thread(...))
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.args
+                ):
+                    info = _ctor(node.args[0])
+                    if info:
+                        containers[node.func.value.id] = (
+                            (node.args[0].lineno,) + info
+                        )
+
+        # Pass 2: ownership transfers out of the scope.
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Call):
+                # x passed to another callable -> ownership moved
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if isinstance(arg, ast.Name) and arg.id in locals_:
+                        transferred.add(arg.id)
+            # return x / self.y = x -> ownership moved
+            if isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ):
+                transferred.add(node.value.id)
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Name
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        transferred.add(node.value.id)
+
+        for name, (line, rule, kind, teardowns) in locals_.items():
+            if name in transferred:
+                continue
+            # Teardown reachable anywhere in the function, incl. nested
+            # closures (shutdown paths often live in a finally).
+            if name in _teardown_calls(fn, teardowns):
+                continue
+            findings.append(Finding(
+                rule=rule, path=rel, line=line,
+                message=(
+                    f"{kind} `{name}` created in {qual} is never "
+                    f"{'/'.join(teardowns)}ed in this scope and its "
+                    "ownership never leaves it"
+                ),
+                hint=f"`{name}.{teardowns[0]}()` before the scope "
+                     "exits (a finally: block survives errors)",
+                symbol=f"{qual}.{name}",
+            ))
+        for cname, (line, rule, kind, teardowns) in containers.items():
+            if _container_teardown(fn, cname, teardowns):
+                continue
+            findings.append(Finding(
+                rule=rule, path=rel, line=line,
+                message=(
+                    f"{kind}s collected into `{cname}` in {qual} are "
+                    f"never {'/'.join(teardowns)}ed (no loop over "
+                    f"`{cname}` tears them down)"
+                ),
+                hint=f"`for t in {cname}: t.{teardowns[0]}()` on the "
+                     "teardown path",
+                symbol=f"{qual}.{cname}[]",
+            ))
+        return findings

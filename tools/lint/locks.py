@@ -1,0 +1,155 @@
+"""LK001 — blocking-call-under-lock analyzer.
+
+The heartbeat, status-endpoint, serve-batcher, and checkpoint-watcher
+threads all share locks with the hot path.  A blocking call made while
+HOLDING one of those locks turns a slow peer into a stalled trainer
+(and two such sites into a deadlock).  Flagged while inside a
+``with <lock>:`` body:
+
+- ``q.get()`` / ``q.put(item)`` with no ``timeout=`` (indefinite queue
+  block; the PR 2 shutdown hangs were exactly this);
+- ``x.join()`` with no timeout (thread join);
+- ``fut.result()`` with no timeout;
+- ``sock.recv(...)`` / ``sock.accept()`` (socket reads);
+- ``time.sleep(...)``, ``ev.wait()`` with no timeout;
+- ``arr.block_until_ready()`` (device sync — the one call that also
+  perturbs the measurement the obs plane exists to take).
+
+A ``with`` target counts as a lock when its terminal name contains
+``lock`` or is a condition variable (``_cv`` / ``cond``).  For a
+condition variable, ``wait``/``wait_for`` on the SAME object is the
+sanctioned idiom (it releases the lock) and is not flagged.
+
+Heuristics keep noise down: ``d.get(key)`` (positional args = dict
+access) and ``", ".join(parts)`` (string receiver / single iterable
+arg) are not flagged.  Nested function bodies defined under the lock
+do not execute under it and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import (
+    Context, Finding, call_name, function_scopes, recv_repr,
+)
+
+_CV_HINTS = ("_cv", "cond")
+
+
+def _is_lock_expr(expr) -> tuple:
+    """(is_lock, receiver, is_cv) for a with-item context expr."""
+    r = recv_repr(expr)
+    if not r:
+        return False, "", False
+    terminal = r.rsplit(".", 1)[-1].lower()
+    if "lock" in terminal:
+        return True, r, False
+    if any(h in terminal for h in _CV_HINTS):
+        return True, r, True
+    return False, r, False
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _blocking_reason(call: ast.Call, cv_receivers: set):
+    """Why this call blocks indefinitely, or None."""
+    func = call.func
+    name = call_name(func)
+    recv = (
+        recv_repr(func.value) if isinstance(func, ast.Attribute) else ""
+    )
+    if name == "get" and not call.args and not _has_timeout(call):
+        # zero positional args = queue.get(); d.get(key) has one.
+        if isinstance(func, ast.Attribute):
+            return "Queue.get() with no timeout"
+    if name == "put" and len(call.args) == 1 and not _has_timeout(call):
+        if isinstance(func, ast.Attribute):
+            return "Queue.put() with no timeout (blocks when full)"
+    if name == "join" and isinstance(func, ast.Attribute):
+        # exclude str.join ("sep".join(parts), receiver-with-arg) and
+        # os.path.join
+        if (
+            not call.args
+            and not isinstance(func.value, (ast.Constant, ast.JoinedStr))
+            and recv.rsplit(".", 1)[-1] != "path"
+        ):
+            return "join() with no timeout"
+    if name == "result" and not call.args and not _has_timeout(call):
+        if isinstance(func, ast.Attribute):
+            return "Future.result() with no timeout"
+    if name in ("recv", "accept") and isinstance(func, ast.Attribute):
+        return f"socket {name}()"
+    if name == "sleep":
+        return "time.sleep()"
+    if name in ("wait", "wait_for") and isinstance(func, ast.Attribute):
+        if recv in cv_receivers:
+            return None  # cv.wait() releases the cv's own lock
+        if not call.args and not _has_timeout(call):
+            return "wait() with no timeout"
+    if name == "block_until_ready":
+        return "device sync (block_until_ready)"
+    return None
+
+
+class LocksRule:
+    name = "locks"
+    rule_ids = ("LK001",)
+
+    def run(self, ctx: Context):
+        findings = []
+        for rel in ctx.package_files():
+            tree = ctx.tree(rel)
+            if tree is None:
+                continue
+            for qual, fn in function_scopes(tree):
+                findings.extend(self._check_scope(rel, qual, fn))
+        return findings
+
+    def _check_scope(self, rel, qual, fn):
+        findings = []
+
+        def visit(node, held, cvs):
+            """Walk statements tracking the set of held locks; nested
+            defs start fresh (their bodies run later, lock not held)."""
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, ast.With):
+                new_held, new_cvs = set(held), set(cvs)
+                for item in node.items:
+                    is_lock, recv, is_cv = _is_lock_expr(
+                        item.context_expr
+                    )
+                    if is_lock:
+                        new_held.add(recv)
+                        if is_cv:
+                            new_cvs.add(recv)
+                for item in node.items:
+                    visit(item.context_expr, held, cvs)
+                for stmt in node.body:
+                    visit(stmt, new_held, new_cvs)
+                return
+            if isinstance(node, ast.Call) and held:
+                reason = _blocking_reason(node, cvs)
+                if reason:
+                    locks = ", ".join(sorted(held))
+                    findings.append(Finding(
+                        rule="LK001", path=rel, line=node.lineno,
+                        message=(
+                            f"blocking call ({reason}) while holding "
+                            f"`{locks}` in {qual}"
+                        ),
+                        hint="add a timeout, or move the blocking "
+                             "call outside the lock",
+                        symbol=f"{qual}.{call_name(node.func)}"
+                               f"@{locks}",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, cvs)
+
+        for stmt in fn.body:
+            visit(stmt, set(), set())
+        return findings

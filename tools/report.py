@@ -808,6 +808,13 @@ _DIRECTION_OVERRIDES = {
     "serve_steady_compiles": "low", "serve.steady_compiles": "low",
     "serve.recompiles_unexpected": "low",
     "serve.requests": None, "serve.swaps": None, "serve.compiles": None,
+    # Static-analysis cleanliness (PR 10): bench preflight runs
+    # `python -m tools.lint` and records the NEW-finding count — a PR
+    # that introduces one regresses the bench compare like any perf
+    # key (0 -> N flags via the inf ratio).  The baselined count is
+    # informational: it should only ever burn DOWN, but shrinking it
+    # must never flag, so no direction.
+    "lint_findings_new": "low", "lint_findings_baselined": None,
 }
 
 

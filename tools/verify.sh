@@ -5,8 +5,8 @@
 # preflight run the exact same thing, so "it passed locally" and "the
 # gate passed" can never mean different commands.
 #
-#   tools/verify.sh            # audits + obs smoke + full tier-1 suite
-#   tools/verify.sh --audit    # static audits only (milliseconds, no jax)
+#   tools/verify.sh            # lint + obs smoke + full tier-1 suite
+#   tools/verify.sh --audit    # static analysis only (milliseconds, no jax)
 #
 # Exit: 0 = every stage ok; nonzero otherwise.  The DOTS_PASSED line at
 # the end is the machine-readable passed count the driver compares
@@ -15,12 +15,14 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== tier-1 marker audit (tools/check_tier1.py) =="
-python tools/check_tier1.py --tests tests --root . || exit 1
-
-echo
-echo "== obs metric-name drift audit (tools/check_obs.py) =="
-python tools/check_obs.py || exit 1
+echo "== static analysis (python -m tools.lint; rule catalog: LINTING.md) =="
+# All seven analyzers: thread/queue/SHM/server lifecycle, donation/
+# aliasing, blocking-under-lock, knob drift, record-schema drift, plus
+# the folded-in tier-1 marker audit (T1001) and obs metric-name drift
+# (OB001/OB002) that used to run here as separate check_tier1/check_obs
+# invocations.  Fails on any NEW finding (tools/lint/baseline.txt
+# grandfathers old ones) — run before anything jax-heavy.
+python -m tools.lint || exit 1
 
 if [ "${1:-}" = "--audit" ]; then
     exit 0
