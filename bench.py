@@ -582,6 +582,27 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     )
 
 
+def _rss_mb() -> float:
+    """Current process RSS in MB (not peak: per-section DELTAS are the
+    point — peak never comes back down, so one section's residue used
+    to skew every later section's reading)."""
+    from fast_tffm_tpu import obs as _obs
+
+    return _obs.read_rss()[0] / (1 << 20)
+
+
+def _with_rss_delta(section_fn, *args) -> dict:
+    """Run one bench section and stamp its own RSS before/delta into
+    its dict — each section's memory story is measured at its own
+    boundaries, regardless of section order."""
+    before = _rss_mb()
+    out = section_fn(*args)
+    if isinstance(out, dict):
+        out["rss_before_mb"] = round(before, 1)
+        out["rss_delta_mb"] = round(_rss_mb() - before, 1)
+    return out
+
+
 def _spread(samples) -> dict:
     """min/max of a repeated-trial rate measurement — the run-to-run
     swing the medians hide (the documented 0.99-1.10 e2e/step drift),
@@ -684,6 +705,105 @@ def _bench_tiered(workers: int) -> dict:
                     - dense["ingest_wait_frac"], 4
                 ),
             }
+    except Exception as e:  # noqa: BLE001 - report, never sink the bench
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return out
+
+
+def _bench_quant(workers: int) -> dict:
+    """Quantized-table section: the BENCH tiered config (V=2^28 Zipf,
+    hot_rows=2^20) trained with each cold_dtype — step rate + real
+    compact cold-store footprint fp32 vs bf16 vs int8 — plus the DENSE
+    table bytes/row of each serving format (measured by quantizing a
+    real table block, not derived): the two byte axes the quantization
+    layer exists to shrink.  The acceptance frame: bf16 >= 2x fewer
+    table bytes/row (int8 ~4x at quant_chunk=64) with e2e step rate
+    within 0.95x of fp32 — quantization must buy bytes, not cost
+    throughput (encode/decode rides the transfer thread, off the
+    dispatch path).
+    """
+    import shutil as _sh
+
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.ops import quant as quant_mod
+    from fast_tffm_tpu.train.loop import Trainer
+
+    out: dict = {"completed": False}
+    tmpdir = tempfile.mkdtemp(prefix="fast_tffm_quant_")
+    try:
+        vocab = 1 << 28
+        hot = 1 << 20
+        batch = 4096
+        epochs = 4
+        rng = np.random.default_rng(13)
+        lines = 8 * batch
+        files = _gen_libsvm_files(tmpdir, rng, 2, lines // 2, 39, vocab)
+        dims = 9  # 1 + factor_num at the bench shapes
+
+        def run(dtype):
+            cfg = FmConfig(
+                vocabulary_size=vocab, factor_num=8, max_features=39,
+                batch_size=batch, learning_rate=0.05,
+                model_file=os.path.join(tmpdir, f"model_{dtype}"),
+                log_steps=0, thread_num=workers, queue_size=workers,
+                epoch_num=epochs, steps_per_dispatch=8,
+                cache_epochs=True, cache_prestacked=True,
+                cache_max_bytes=4 << 30,
+                train_files=files, save_steps=0,
+                table_tiering="on", hot_rows=hot, cold_dtype=dtype,
+            )
+            r = Trainer(cfg).train()
+            _sh.rmtree(cfg.model_file, ignore_errors=True)
+            snap = r["train"].get("tiered", {})
+            return {
+                "examples_per_sec": round(
+                    r["train"]["examples_per_sec"], 1
+                ),
+                "cold_store_bytes": snap.get("cold_store_bytes", 0),
+                "cold_bytes_per_row": snap.get("cold_bytes_per_row", 0),
+                "hot_hit_frac": snap.get("hot_hit_frac", 0.0),
+            }
+
+        runs = {}
+        for dtype in ("fp32", "bf16", "int8"):
+            runs[dtype] = run(dtype)
+        # Dense (serving-format) bytes/row, measured on a real block.
+        block = np.random.default_rng(5).normal(
+            0, 0.01, (4096, dims)
+        ).astype(np.float32)
+        dense_bpr = {"fp32": 4.0 * dims}
+        for dtype in ("bf16", "int8"):
+            qt = quant_mod.quantize_table(block, dtype, 64)
+            dense_bpr[dtype] = round(qt.nbytes / len(block), 3)
+        fp32_rate = runs["fp32"]["examples_per_sec"]
+        out.update({
+            "completed": True,
+            "vocab_log2": 28,
+            "hot_rows_log2": 20,
+            "epochs": epochs,
+            "quant_chunk": 64,
+            "runs": runs,
+            "table_bytes_per_row": dense_bpr,
+            # Bytes-per-row ratios are the gated axis (deterministic —
+            # cold_store_bytes is workload-dependent: a run whose hot
+            # set never overflows writes no overlay rows at all, and
+            # 0/0 would gate nothing).
+            "cold_bytes_per_row_frac": {
+                d: round(
+                    runs[d]["cold_bytes_per_row"]
+                    / max(1, runs["fp32"]["cold_bytes_per_row"]), 4
+                )
+                for d in ("bf16", "int8")
+            },
+            "step_rate_frac": {
+                d: round(
+                    runs[d]["examples_per_sec"] / fp32_rate, 4
+                ) if fp32_rate > 0 else 0.0
+                for d in ("bf16", "int8")
+            },
+        })
     except Exception as e:  # noqa: BLE001 - report, never sink the bench
         out["error"] = f"{type(e).__name__}: {e}"
     finally:
@@ -806,6 +926,31 @@ def _bench_serve(workers: int) -> dict:
         arr = np.array(lats) * 1e3
         snap = tel.snapshot()
         counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+        timers = snap.get("timers", {})
+        # Quantized-serving sizing probe: place the SAME params as an
+        # int8 table (no HTTP window — placement sets the table-bytes
+        # and probe-error gauges; no ladder compiles happen) so the
+        # serve section reports the replica-density numbers next to
+        # the latency ones.
+        try:
+            q_tel = _obs.Telemetry()
+            q_cfg = FmConfig(
+                vocabulary_size=1 << 20, factor_num=8, max_features=39,
+                batch_size=1024, serve_table_dtype="int8",
+                quant_chunk=64,
+                model_file="/tmp/fast_tffm_serve_bench_q",
+            )
+            FixedShapeScorer(q_cfg, params, telemetry=q_tel)
+            q_gauges = q_tel.snapshot().get("gauges", {})
+            out["serve_table_mb_int8"] = round(
+                q_gauges.get("serve.table_bytes", 0) / (1 << 20), 3
+            )
+            out["serve_quant_error_max_int8"] = round(
+                float(q_gauges.get("serve.quant_error_max", 0.0)), 6
+            )
+        except Exception as e:  # noqa: BLE001 - probe must not sink it
+            out["quant_probe_error"] = f"{type(e).__name__}: {e}"
         out.update({
             "completed": True,
             "clients": n_clients,
@@ -823,6 +968,15 @@ def _bench_serve(workers: int) -> dict:
             "warmup_compiles": warm_compiles,
             "serve_steady_compiles": int(scorer.steady_compiles),
             "max_batch_wait_ms": cfg.max_batch_wait_ms,
+            # Device-resident table footprint of THIS (fp32) server and
+            # the measured per-request text-parse cost (the host time a
+            # binary transport would remove — serve.parse timer).
+            "serve_table_mb": round(
+                gauges.get("serve.table_bytes", 0) / (1 << 20), 3
+            ),
+            "serve_parse_p50_ms": float(
+                (timers.get("serve.parse") or {}).get("p50_ms", 0.0)
+            ),
         })
         if errors:
             out["client_errors"] = errors[:5]
@@ -949,6 +1103,7 @@ def main() -> int:
     s_samples, s1_samples, e_samples = [], [], []
     tiered_section = None
     serve_section = None
+    quant_section = None
     dispatch_overhead_ms, h2d_overlap_frac = 0.0, 0.0
     e2e_epoch0, e2e_cached = 0.0, 0.0
     ingest_threads_rate, ingest_procs_rate = 0.0, 0.0
@@ -1225,11 +1380,18 @@ def main() -> int:
             # leave ~7 GB of process RSS behind, and serving latency
             # measured under that allocator pressure read ~10x worse
             # than the same probe on a clean process.
-            serve_section = _bench_serve(workers)
+            # Every section stamps its own RSS before/delta
+            # (_with_rss_delta): the tiered section's ~7 GB residue can
+            # never skew another section's memory reading again,
+            # whatever the order.
+            serve_section = _with_rss_delta(_bench_serve, workers)
             # Tiered-table section: the V=2^28 run a dense device table
             # cannot hold, plus its dense V=2^26 overlap baseline.  Its
             # own trainers/files; isolated from the judged numbers above.
-            tiered_section = _bench_tiered(workers)
+            tiered_section = _with_rss_delta(_bench_tiered, workers)
+            # Quantized-table section: the same tiered config trained
+            # under each cold_dtype (bytes per row vs step rate).
+            quant_section = _with_rss_delta(_bench_quant, workers)
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         e2e_err = f"bench failed: {type(e).__name__}: {e}"
 
@@ -1366,6 +1528,29 @@ def main() -> int:
             for key in ("serve_p50_ms", "serve_p95_ms", "serve_p99_ms",
                         "serve_qps", "serve_batch_fill",
                         "serve_steady_compiles"):
+                result[key] = serve_section[key]
+    if quant_section is not None:
+        result["quantized_table"] = quant_section
+        if quant_section.get("completed"):
+            # Top-level copies of the gated axes (--compare flattens
+            # numeric top-level keys only): table bytes must FALL
+            # (that is the feature), step rate must not (encode/decode
+            # rides the transfer thread, off the dispatch path).
+            for d in ("bf16", "int8"):
+                # Dense (serving-format) bytes/row vs fp32 — the
+                # replica-density headline (bf16 0.5, int8 ~0.25 at
+                # quant_chunk=64).
+                result[f"quant_table_bytes_frac_{d}"] = round(
+                    quant_section["table_bytes_per_row"][d]
+                    / quant_section["table_bytes_per_row"]["fp32"], 4
+                )
+                result[f"quant_step_rate_frac_{d}"] = (
+                    quant_section["step_rate_frac"][d]
+                )
+    if serve_section is not None and serve_section.get("completed"):
+        for key in ("serve_table_mb", "serve_parse_p50_ms",
+                    "serve_quant_error_max_int8"):
+            if key in serve_section:
                 result[key] = serve_section[key]
     if tier1_audit is not None:
         result["tier1_audit"] = tier1_audit

@@ -98,6 +98,28 @@ def build_argparser() -> argparse.ArgumentParser:
         help="device-resident rows when --table_tiering on (must cover "
              "one super-batch's unique ids)",
     )
+    # Quantized-table knobs (override the cfg file; see ops/quant.py).
+    p.add_argument(
+        "--cold_dtype", choices=["fp32", "bf16", "int8"], default=None,
+        help="storage dtype of the tiered cold store's rows (requires "
+             "--table_tiering on): bf16 halves / int8 quarters host "
+             "bytes per cold row; training stays f32 on the hot table "
+             "and scores within a pinned tolerance of fp32",
+    )
+    p.add_argument(
+        "--serve_table_dtype", choices=["fp32", "bf16", "int8"],
+        default=None,
+        help="device-resident serving-table dtype (serve mode + "
+             "offline predict): quantized tables hold 2-4x more rows "
+             "per byte with dequant fused into the compiled rungs "
+             "(steady-state still compiles nothing)",
+    )
+    p.add_argument(
+        "--quant_chunk", type=int, default=None,
+        help="int8 scale granularity for dense quantized tables: this "
+             "many consecutive rows share one fp32 scale (0 = one "
+             "scale per row)",
+    )
     # Observability knobs (override the cfg file).
     p.add_argument(
         "--heartbeat_secs", type=float, default=None,
@@ -235,6 +257,7 @@ def main(argv=None) -> int:
                     "parse_processes", "cache_epochs", "cache_max_bytes",
                     "cache_prestacked", "ring_slots", "heartbeat_secs",
                     "trace_file", "nan_policy", "table_tiering", "hot_rows",
+                    "cold_dtype", "serve_table_dtype", "quant_chunk",
                     "status_port", "status_host", "alert_rules",
                     "trace_rotate_events", "serve_port", "serve_host",
                     "serve_batch_sizes", "max_batch_wait_ms",

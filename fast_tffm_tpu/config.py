@@ -300,6 +300,32 @@ class FmConfig:
     # unique id of one super-batch (steps_per_dispatch * batch_size *
     # max_features is a safe upper bound); clamped to vocabulary_size.
     hot_rows: int = 1 << 22
+    # Storage dtype of the tiered COLD store's rows (table_tiering=on):
+    # "fp32" (default; bit-exact, the pre-quantization behavior),
+    # "bf16" (half the host bytes per cold row), or "int8" (symmetric
+    # codes + one fp32 scale per row — rows migrate hot<->cold
+    # individually, so scales are per-row here; see ops/quant.py and
+    # EMBEDDING.md).  Cold rows are stored compact, dequantized on
+    # hot-load, re-quantized on write-back; the device hot table (and
+    # training math) stays float32.  Non-fp32 training is parity-
+    # within-tolerance vs fp32, not bitwise (pinned by
+    # tests/test_quant.py).
+    cold_dtype: str = "fp32"
+    # Storage dtype of the device-resident SERVING table (serve mode +
+    # offline predict through the ladder): "fp32" | "bf16" | "int8".
+    # Quantized tables hold 2-4x more rows per byte of device memory —
+    # replica density — with dequant fused into the compiled rungs
+    # (served scores stay within a pinned tolerance of fp32; the
+    # steady-state zero-compile contract is unchanged).  See
+    # SERVING.md.
+    serve_table_dtype: str = "fp32"
+    # int8 scale granularity for DENSE quantized tables (the serving
+    # table and the quant.npz checkpoint): this many consecutive rows
+    # share one fp32 scale (0 = one scale per row).  64 amortizes the
+    # scale to ~0.06 B/row (the ~4x point at D=9) while bounding an
+    # outlier row's precision blast radius to its own chunk.  The
+    # tiered cold store always uses per-row scales regardless.
+    quant_chunk: int = 64
     # How multi-device sparse updates are exchanged over the data axis
     # (both the shardmap step and the GSPMD sharded tile apply; the
     # reference's IndexedSlices push, SURVEY.md §3.2): "dense" psums
@@ -431,6 +457,25 @@ class FmConfig:
             )
         if self.hot_rows < 1:
             raise ValueError(f"hot_rows must be >= 1, got {self.hot_rows}")
+        if self.cold_dtype not in ("fp32", "bf16", "int8"):
+            raise ValueError(f"unknown cold_dtype {self.cold_dtype!r}")
+        if self.serve_table_dtype not in ("fp32", "bf16", "int8"):
+            raise ValueError(
+                f"unknown serve_table_dtype {self.serve_table_dtype!r}"
+            )
+        if self.quant_chunk < 0:
+            raise ValueError(
+                f"quant_chunk must be >= 0, got {self.quant_chunk}"
+            )
+        if self.cold_dtype != "fp32" and self.table_tiering != "on":
+            # The silently-inert-knob hazard (same discipline as
+            # alert_rules-without-heartbeat): cold_dtype names the
+            # tiered cold store's storage format, and without tiering
+            # there is no cold store for it to apply to.
+            raise ValueError(
+                "cold_dtype != fp32 requires table_tiering=on (it is "
+                "the storage dtype of the tiered cold store)"
+            )
         if self.cache_prestacked and not self.cache_epochs:
             raise ValueError(
                 "cache_prestacked requires cache_epochs (it is a storage "
@@ -565,6 +610,9 @@ _KEYMAP = {
     "ring_slots": ("ring_slots", int),
     "table_tiering": ("table_tiering", str),
     "hot_rows": ("hot_rows", int),
+    "cold_dtype": ("cold_dtype", str),
+    "serve_table_dtype": ("serve_table_dtype", str),
+    "quant_chunk": ("quant_chunk", int),
 }
 
 

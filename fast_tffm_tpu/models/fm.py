@@ -125,6 +125,30 @@ def ffm_scores_from_rows(
     return (w0 + linear + inter).astype(jnp.float32)
 
 
+def scores_from_rows(
+    w0: jax.Array,
+    rows: jax.Array,  # [B, F, D] gathered (and, if needed, dequantized)
+    vals: jax.Array,  # [B, F]
+    fields: Optional[jax.Array],
+    *,
+    factor_num: int,
+    field_num: int = 0,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Score from pre-gathered rows — the shared tail of the fp32 and
+    quantized forwards (plain FM and FFM both).  ``rows`` may arrive
+    in any storage dtype (f32, bf16, or int8 already widened by
+    ops.quant.dequant_gathered): both score paths upcast operands to
+    the compute dtype and accumulate in f32."""
+    if field_num:
+        assert fields is not None
+        return ffm_scores_from_rows(
+            w0, rows, vals, fields, factor_num, field_num, compute_dtype
+        )
+    linear, s1, s2 = interaction_terms(rows, vals, compute_dtype)
+    return scores_from_terms(w0.astype(compute_dtype), linear, s1, s2)
+
+
 def fm_scores(
     params: FmParams,
     ids: jax.Array,  # [B, F] int32
@@ -135,15 +159,48 @@ def fm_scores(
     field_num: int = 0,
     compute_dtype=jnp.float32,
 ) -> jax.Array:
-    """Oracle forward: gather + score. One `take` = one gather op for XLA."""
+    """Oracle forward: gather + score. One `take` = one gather op for XLA.
+
+    ``params.table`` may be stored bf16 (the compact serving format):
+    the gather reads compact rows and :func:`scores_from_rows` widens
+    them in-register — XLA fuses the cast into the gather.
+    """
     rows = params.table[ids]  # [B, F, D]
-    if field_num:
-        assert fields is not None
-        return ffm_scores_from_rows(
-            params.w0, rows, vals, fields, factor_num, field_num, compute_dtype
-        )
-    linear, s1, s2 = interaction_terms(rows, vals, compute_dtype)
-    return scores_from_terms(params.w0.astype(compute_dtype), linear, s1, s2)
+    return scores_from_rows(
+        params.w0, rows, vals, fields,
+        factor_num=factor_num, field_num=field_num,
+        compute_dtype=compute_dtype,
+    )
+
+
+def fm_scores_dequant(
+    w0: jax.Array,
+    codes: jax.Array,  # [V, D] int8 table codes
+    scales: jax.Array,  # [ceil(V/chunk)] f32 scale chunks
+    chunk: int,
+    ids: jax.Array,  # [B, F] int32
+    vals: jax.Array,  # [B, F] float32
+    fields: Optional[jax.Array] = None,
+    *,
+    factor_num: int,
+    field_num: int = 0,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Forward over an int8-quantized table: gather compact codes (a
+    quarter of the fp32 row bytes) plus each row's scale chunk, widen
+    in-register (ops.quant.dequant_gathered), score.  Identical math
+    to :func:`fm_scores` on the dequantized table, pinned by
+    tests/test_quant.py."""
+    from fast_tffm_tpu.ops import quant
+
+    code_rows = codes[ids]  # [B, F, D] int8
+    scale_rows = scales[ids // chunk if chunk > 1 else ids]
+    rows = quant.dequant_gathered(code_rows, scale_rows)
+    return scores_from_rows(
+        w0, rows, vals, fields,
+        factor_num=factor_num, field_num=field_num,
+        compute_dtype=compute_dtype,
+    )
 
 
 def example_losses(scores: jax.Array, labels: jax.Array, loss_type: str) -> jax.Array:
@@ -201,13 +258,11 @@ def loss_and_metrics(
     Returns ``(loss, aux)`` for ``jax.value_and_grad(..., has_aux=True)``.
     """
     rows = params.table[ids]
-    if cfg.field_num:
-        scores = ffm_scores_from_rows(
-            params.w0, rows, vals, fields, cfg.factor_num, cfg.field_num, compute_dtype
-        )
-    else:
-        linear, s1, s2 = interaction_terms(rows, vals, compute_dtype)
-        scores = scores_from_terms(params.w0.astype(compute_dtype), linear, s1, s2)
+    scores = scores_from_rows(
+        params.w0, rows, vals, fields,
+        factor_num=cfg.factor_num, field_num=cfg.field_num,
+        compute_dtype=compute_dtype,
+    )
     # scores are f32 regardless of compute_dtype (both score paths
     # accumulate and return f32), so loss/metrics math stays f32.
     per_ex = example_losses(scores, labels, cfg.loss_type)

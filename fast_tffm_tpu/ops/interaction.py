@@ -24,7 +24,10 @@ from fast_tffm_tpu.platform import use_interpret as _use_interpret
 def _scores_jnp(rows, vals):
     # Upcast once: in bf16-input mode only the STORED rows/vals are
     # rounded — accumulation and the returned scores/s1 stay f32, matching
-    # the Pallas kernels' contract.
+    # the Pallas kernels' contract.  The same astype is the in-register
+    # widening of a bf16-STORED serving table (ops.quant): the gather
+    # reads compact rows, this cast fuses into it, and everything
+    # downstream is f32 either way.
     rows = rows.astype(jnp.float32)
     vals = vals.astype(jnp.float32)
     w = rows[..., 0]

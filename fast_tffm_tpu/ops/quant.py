@@ -1,0 +1,301 @@
+"""Quantized embedding-row storage: bf16 / int8-with-fp32-scales.
+
+PR 8's arithmetic-intensity numbers showed the FM step is
+bytes-dominated — almost all traffic per dispatch is embedding-row
+reads/writes, not FLOPs — so the lever is bytes per row.  This module
+is the ONE place the row formats live; every other layer (the tiered
+cold store, the ``quant.npz`` checkpoint, the serving ladder, the
+convert tool) composes these primitives:
+
+- ``bf16``: rows stored as bfloat16 (half the bytes).  No scales —
+  bf16 shares float32's exponent range, so truncating the mantissa is
+  the whole transform.  Dequantization is a plain ``astype`` that XLA
+  fuses into the gather (read compact, widen in-register).
+- ``int8``: symmetric linear quantization with float32 scales.
+  scale = max|x| / 127 over a scale group; codes = round(x / scale)
+  in [-127, 127]; an all-zero group stores scale 0 and reproduces
+  exactly.  Scale granularity differs by where the rows live:
+
+  * DENSE tables (the device-resident serving table, the ``quant.npz``
+    checkpoint): one scale per chunk of ``quant_chunk`` consecutive
+    rows (:class:`QuantTable`).  At chunk 64 and D = 9 that is
+    9 + 4/64 ≈ 9.06 B/row — the ≈4x the serving replica-density math
+    wants.  Chunking also bounds the blast radius of an outlier row:
+    it flattens the precision of its own chunk only.  ``chunk 0`` =
+    one scale per row.
+  * the tiered COLD store: one scale per row, always — rows migrate
+    hot<->cold individually, so a shared scale would need re-encoding
+    neighbors on every write-back.  D + 4 B/row (~2.8x at D = 9).
+
+Two representations:
+
+- UNPACKED, what compute wants: ``(codes, scales)`` arrays.
+- PACKED, what row-granular storage wants: one uint8
+  ``[n, bytes_per_row]`` array (:class:`RowCodec`).  The tiered
+  overlay machinery (sorted merges, fancy indexing, np.savez without
+  pickle) only ever shuffles rows of one 2-D array, so packing keeps
+  it — and the overlay checkpoint format — completely dtype-agnostic.
+  fp32 is the identity codec: rows pass through as float32, bit-exact
+  (the pre-quantization behavior).
+
+:func:`dequant_gathered` is the jax-side fused dequant the compiled
+score path uses (``codes[ids]`` gathers compact rows; the cast +
+scale multiply widen them in-register).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import ml_dtypes
+
+DTYPES = ("fp32", "bf16", "int8")
+
+bfloat16 = ml_dtypes.bfloat16
+
+
+def validate_dtype(dtype: str, what: str = "dtype") -> str:
+    if dtype not in DTYPES:
+        raise ValueError(f"unknown {what} {dtype!r} (one of {DTYPES})")
+    return dtype
+
+
+def _group_of(n: int, chunk: int) -> np.ndarray:
+    """[n] i64: which scale group each row belongs to."""
+    if chunk <= 1:
+        return np.arange(n, dtype=np.int64)
+    return np.arange(n, dtype=np.int64) // chunk
+
+
+def quantize_int8(rows: np.ndarray, chunk: int = 0) -> tuple:
+    """f32 [n, dim] -> (codes int8 [n, dim], scales f32 [G]).
+
+    ``chunk`` consecutive rows share a scale (G = ceil(n/chunk));
+    chunk <= 1 = one scale per row (G = n).  Symmetric: the largest
+    |element| of a group maps to ±127.
+    """
+    rows = np.asarray(rows, np.float32)
+    n = len(rows)
+    per_row = np.abs(rows).max(axis=1) if rows.size else np.zeros(
+        (0,), np.float32
+    )
+    if chunk <= 1:
+        amax = per_row
+    elif n == 0:
+        amax = np.zeros(0, np.float32)
+    else:
+        # Vectorized group max: pad to a chunk multiple and reshape
+        # (zeros never win a max of absolutes).  np.maximum.at is a
+        # scalar loop — tens of seconds at V=2^28, and this runs on
+        # the hot-swap staging path.
+        g = -(-n // chunk)
+        pad = g * chunk - n
+        padded = np.pad(per_row, (0, pad)) if pad else per_row
+        amax = padded.reshape(g, chunk).max(axis=1)
+    scales = amax / np.float32(127.0)
+    safe = np.where(scales > 0, scales, np.float32(1.0))
+    codes = np.clip(
+        np.rint(rows / safe[_group_of(n, chunk), None]), -127, 127
+    ).astype(np.int8)
+    return codes, scales.astype(np.float32)
+
+
+def dequantize_int8(codes: np.ndarray, scales: np.ndarray,
+                    chunk: int = 0) -> np.ndarray:
+    return codes.astype(np.float32) * scales[
+        _group_of(len(codes), chunk), None
+    ]
+
+
+def dequant_gathered(codes_rows, scale_rows):
+    """Fused jax-side dequant for gathered rows: ``codes_rows`` int8
+    ``[..., dim]`` (from ``codes[ids]``), ``scale_rows`` f32 ``[...]``
+    (from ``scales[ids // chunk]``).  The cast + multiply happen
+    in-register after the compact gather — the compiled step reads a
+    quarter of the row bytes and widens on-chip."""
+    import jax.numpy as jnp
+
+    return codes_rows.astype(jnp.float32) * scale_rows[..., None]
+
+
+# ----------------------------------------------------------------------
+# Dense quantized tables (serving ladder + quant.npz checkpoint)
+# ----------------------------------------------------------------------
+
+
+class QuantParams(NamedTuple):
+    """Device-resident int8 serving params (the quantized analogue of
+    fm.FmParams): ``codes`` int8 [V, dim], ``scales`` f32
+    [ceil(V/chunk)] — a NamedTuple so it is a jax pytree the compiled
+    rungs take as an argument (hot-swappable by reference, like the
+    fp32 params)."""
+
+    w0: object
+    codes: object
+    scales: object
+
+
+class QuantTable(NamedTuple):
+    """One host-side quantized dense table.
+
+    ``codes``: int8 [V, dim] (int8) or bfloat16 [V, dim] (bf16);
+    ``scales``: f32 [ceil(V/chunk)] for int8, None for bf16."""
+
+    dtype: str
+    chunk: int
+    codes: np.ndarray
+    scales: Optional[np.ndarray]
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.codes.nbytes) + (
+            int(self.scales.nbytes) if self.scales is not None else 0
+        )
+
+    def descriptor(self) -> dict:
+        d = {
+            "dtype": self.dtype,
+            "vocab": int(self.codes.shape[0]),
+            "dim": int(self.codes.shape[1]),
+        }
+        if self.dtype == "int8":
+            d["chunk"] = int(self.chunk)
+        return d
+
+
+def quantize_table(table: np.ndarray, dtype: str,
+                   chunk: int = 0) -> QuantTable:
+    """f32 [V, dim] -> :class:`QuantTable` (``dtype`` bf16 or int8)."""
+    validate_dtype(dtype)
+    if dtype == "fp32":
+        raise ValueError("fp32 tables are not quantized; use the array")
+    table = np.ascontiguousarray(table, np.float32)
+    if dtype == "bf16":
+        return QuantTable("bf16", 0, table.astype(bfloat16), None)
+    codes, scales = quantize_int8(table, chunk)
+    return QuantTable("int8", chunk, codes, scales)
+
+
+def dequantize_table(qt: QuantTable) -> np.ndarray:
+    if qt.dtype == "bf16":
+        return qt.codes.astype(np.float32)
+    return dequantize_int8(qt.codes, qt.scales, qt.chunk)
+
+
+def dequantize_rows(qt: QuantTable, ids: np.ndarray) -> np.ndarray:
+    """f32 rows for ``ids`` (any shape) WITHOUT materializing the full
+    dequantized table — O(len(ids)) work and memory (the placement-time
+    probe's path; dequantize_table at V=2^28 would be a multi-GB
+    allocation to read 256 rows)."""
+    codes = qt.codes[ids]
+    if qt.dtype == "bf16":
+        return codes.astype(np.float32)
+    scales = qt.scales[ids // qt.chunk if qt.chunk > 1 else ids]
+    return codes.astype(np.float32) * scales[..., None]
+
+
+def table_to_arrays(qt: QuantTable) -> dict:
+    """npz-safe arrays (bf16 codes as a uint16 bit view)."""
+    out = {"codes": (
+        qt.codes.view(np.uint16) if qt.dtype == "bf16" else qt.codes
+    )}
+    if qt.scales is not None:
+        out["scales"] = qt.scales
+    return out
+
+
+def table_from_arrays(descriptor: dict, arrays: dict) -> QuantTable:
+    dtype = descriptor["dtype"]
+    codes = arrays["codes"]
+    if dtype == "bf16":
+        codes = codes.view(bfloat16)
+    return QuantTable(
+        dtype, int(descriptor.get("chunk", 0)), codes,
+        arrays.get("scales"),
+    )
+
+
+# ----------------------------------------------------------------------
+# Row-granular packed storage (the tiered cold store)
+# ----------------------------------------------------------------------
+
+
+class RowCodec:
+    """Encode/decode one row-block format (see module docstring).
+
+    int8 rows pack a PER-ROW fp32 scale after the codes (rows must
+    stay independent — they migrate hot<->cold one at a time), so one
+    packed row is ``dim + 4`` bytes; bf16 rows are ``2 * dim`` bytes;
+    fp32 rows pass through as float32.
+    """
+
+    def __init__(self, dtype: str, dim: int):
+        validate_dtype(dtype)
+        self.dtype = dtype
+        self.dim = dim
+        if dtype == "fp32":
+            self.bytes_per_row = 4 * dim
+            self.width = dim
+            self.storage_dtype = np.dtype(np.float32)
+        elif dtype == "bf16":
+            self.bytes_per_row = 2 * dim
+            self.width = self.bytes_per_row
+            self.storage_dtype = np.dtype(np.uint8)
+        else:  # int8 + one f32 scale
+            self.bytes_per_row = dim + 4
+            self.width = self.bytes_per_row
+            self.storage_dtype = np.dtype(np.uint8)
+
+    def empty(self, n: int) -> np.ndarray:
+        return np.empty((n, self.width), self.storage_dtype)
+
+    def encode(self, rows: np.ndarray) -> np.ndarray:
+        """f32 [n, dim] -> packed [n, width] (always a fresh array)."""
+        rows = np.ascontiguousarray(rows, np.float32)
+        if self.dtype == "fp32":
+            return rows.copy()
+        if self.dtype == "bf16":
+            return np.ascontiguousarray(
+                rows.astype(bfloat16)
+            ).view(np.uint8).reshape(len(rows), self.width)
+        codes, scales = quantize_int8(rows, 0)
+        packed = np.empty((len(rows), self.width), np.uint8)
+        packed[:, :self.dim] = codes.view(np.uint8)
+        packed[:, self.dim:] = np.ascontiguousarray(
+            scales
+        ).view(np.uint8).reshape(len(rows), 4)
+        return packed
+
+    def decode(self, packed: np.ndarray) -> np.ndarray:
+        """packed [n, width] -> f32 [n, dim].  fp32 is the identity
+        (no copy: dense-path callers rely on fancy indexing having
+        copied already)."""
+        if self.dtype == "fp32":
+            return packed
+        if self.dtype == "bf16":
+            return np.ascontiguousarray(packed).view(
+                bfloat16
+            ).astype(np.float32)
+        packed = np.ascontiguousarray(packed)
+        codes = packed[:, :self.dim].view(np.int8)
+        scales = np.ascontiguousarray(packed[:, self.dim:]).view(
+            np.float32
+        ).reshape(len(packed))
+        return codes.astype(np.float32) * scales[:, None]
+
+    def descriptor(self) -> dict:
+        """The format identity an overlay checkpoint must carry (and a
+        restore must match): {} for fp32, so pre-quantization
+        descriptors keep matching byte-for-byte.  No ``chunk`` — the
+        packed cold format is per-row-scale by construction."""
+        return {} if self.dtype == "fp32" else {"dtype": self.dtype}
+
+    def __repr__(self) -> str:
+        return f"RowCodec({self.dtype}, dim={self.dim})"
+
+
+def cold_codec(cfg) -> RowCodec:
+    """The cold-store row codec an FmConfig implies."""
+    return RowCodec(cfg.cold_dtype, cfg.embedding_dim)

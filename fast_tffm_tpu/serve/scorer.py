@@ -53,6 +53,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from fast_tffm_tpu import obs
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.models import fm
+from fast_tffm_tpu.ops import quant
 from fast_tffm_tpu.parallel import mesh as mesh_lib
 from fast_tffm_tpu.train import checkpoint
 from fast_tffm_tpu.train import tiered as tiered_lib
@@ -285,56 +286,226 @@ class _LadderScorer:
 class FixedShapeScorer(_LadderScorer):
     """Dense-table scorer: params device-resident, hot-swappable.
 
-    ``params`` may be a host-numpy or device :class:`fm.FmParams`; it is
-    placed with the mesh's param sharding either way.
+    ``params`` may be a host-numpy or device :class:`fm.FmParams`
+    (fp32), or — for a ``quant.npz`` checkpoint — a ``(w0,
+    quant.QuantTable)`` pair; it is placed with the mesh's param
+    sharding either way.
+
+    ``serve_table_dtype`` picks the DEVICE-RESIDENT storage format:
+
+    - ``fp32`` — the historical path, bit-identical scores;
+    - ``bf16`` — the table device-residents as bfloat16 (half the
+      bytes); the compiled rungs gather compact rows and the existing
+      f32 upcast in the score math widens them in-register;
+    - ``int8`` — codes + per-``quant_chunk``-rows fp32 scales
+      (~quarter the bytes); the compiled rungs run
+      ``fm.fm_scores_dequant`` (gather codes + scale chunk, widen
+      in-register, score).
+
+    Either way the rung shapes are unchanged, so the AOT ladder /
+    zero-steady-compile contract and the hot-swap protocol carry over
+    verbatim: an fp32 checkpoint swap quantizes host-side into standby
+    buffers off-traffic.  ``serve.table_bytes`` gauges the resident
+    table footprint and ``serve.quant_error_max`` the max
+    |score_fp32 − score_quant| on a deterministic probe batch measured
+    at placement time (0 for fp32).
     """
 
-    def __init__(self, cfg: FmConfig, params: fm.FmParams, mesh=None,
+    def __init__(self, cfg: FmConfig, params, mesh=None,
                  telemetry=None, writer=None, extra_rungs=(), step=0):
         super().__init__(cfg, mesh=mesh, telemetry=telemetry,
                          writer=writer, extra_rungs=extra_rungs)
         self.step = int(step)
+        self.table_dtype = quant.validate_dtype(
+            cfg.serve_table_dtype, "serve_table_dtype"
+        )
+        self._chunk = cfg.quant_chunk
         self._param_sh = mesh_lib.param_sharding(self.mesh)
+        self._g_table_bytes = self._tel.gauge("serve.table_bytes")
+        self._g_quant_err = self._tel.gauge("serve.quant_error_max")
         self._params = self._place(params)
-        if cfg.field_num:
-            def score_fn(params, ids, vals, fields):
-                return self._finish(fm.fm_scores(
-                    params, ids, vals, fields,
-                    factor_num=cfg.factor_num, field_num=cfg.field_num,
-                ))
+        if self.table_dtype == "int8":
+            chunk = self._chunk
+            if cfg.field_num:
+                def score_fn(params, ids, vals, fields):
+                    return self._finish(fm.fm_scores_dequant(
+                        params.w0, params.codes, params.scales, chunk,
+                        ids, vals, fields,
+                        factor_num=cfg.factor_num,
+                        field_num=cfg.field_num,
+                    ))
+            else:
+                def score_fn(params, ids, vals):
+                    return self._finish(fm.fm_scores_dequant(
+                        params.w0, params.codes, params.scales, chunk,
+                        ids, vals, None,
+                        factor_num=cfg.factor_num, field_num=0,
+                    ))
+            param_sh_tree = quant.QuantParams(
+                w0=self._param_sh.w0,
+                codes=self._param_sh.table,
+                # The scale vector is tiny (V / chunk floats) and 1-D:
+                # replicate it rather than invent a 1-axis sharding.
+                scales=NamedSharding(self.mesh, P()),
+            )
         else:
-            def score_fn(params, ids, vals):
-                return self._finish(fm.fm_scores(
-                    params, ids, vals, None,
-                    factor_num=cfg.factor_num, field_num=0,
-                ))
+            # fp32 and bf16 share the FmParams score path: the gather
+            # reads whatever dtype the table stores and the score
+            # math's astype widens it in-register (ops/interaction.py).
+            if cfg.field_num:
+                def score_fn(params, ids, vals, fields):
+                    return self._finish(fm.fm_scores(
+                        params, ids, vals, fields,
+                        factor_num=cfg.factor_num,
+                        field_num=cfg.field_num,
+                    ))
+            else:
+                def score_fn(params, ids, vals):
+                    return self._finish(fm.fm_scores(
+                        params, ids, vals, None,
+                        factor_num=cfg.factor_num, field_num=0,
+                    ))
+            param_sh_tree = self._param_sh
         self._jit = jax.jit(
             score_fn,
             in_shardings=(
-                (self._param_sh,) + self._arg_sh[:self._n_args]
+                (param_sh_tree,) + self._arg_sh[:self._n_args]
             ),
             donate_argnums=tuple(range(1, 1 + self._n_args)),
         )
 
-    def _place(self, params: fm.FmParams) -> fm.FmParams:
-        placed = fm.FmParams(
-            w0=jax.device_put(
-                jnp.asarray(params.w0, jnp.float32), self._param_sh.w0
-            ),
-            table=jax.device_put(
-                jnp.asarray(params.table, jnp.float32),
-                self._param_sh.table,
-            ),
+    # -- placement (construction + hot-swap staging) -------------------
+
+    def _probe_quant_error(self, w0, table_f32: np.ndarray,
+                           qt: "quant.QuantTable") -> float:
+        """max |served_fp32 − served_quant| on a deterministic probe
+        batch — host-side eager math (no ladder compile, so warmup
+        accounting stays exact), gathering ONLY the probe rows from
+        either side (dequantizing the full [V, D] table to read a few
+        hundred rows would be a multi-GB allocation per hot-swap at
+        real vocabularies); the REAL compiled-path tolerance is pinned
+        by tests/test_quant.py."""
+        cfg = self.cfg
+        rng = np.random.default_rng(0xC0FFEE)
+        n = min(256, cfg.vocabulary_size)
+        ids = rng.integers(
+            0, cfg.vocabulary_size, (n, cfg.max_features)
+        ).astype(np.int64)
+        vals = rng.uniform(0.1, 1.0, ids.shape).astype(np.float32)
+        fields = (
+            rng.integers(0, cfg.field_num, ids.shape).astype(np.int32)
+            if cfg.field_num else None
         )
+        w0j = jnp.asarray(w0, jnp.float32)
+
+        def score(rows):
+            return self._finish(fm.scores_from_rows(
+                w0j, jnp.asarray(rows), jnp.asarray(vals),
+                None if fields is None else jnp.asarray(fields),
+                factor_num=cfg.factor_num, field_num=cfg.field_num,
+            ))
+
+        return float(jnp.max(jnp.abs(
+            score(table_f32[ids]) - score(quant.dequantize_rows(qt, ids))
+        )))
+
+    def _place(self, params):
+        dtype = self.table_dtype
+        if isinstance(params, fm.FmParams):
+            qt = None
+        else:
+            try:
+                w0_in, qt = params
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "FixedShapeScorer params must be fm.FmParams or a "
+                    f"(w0, QuantTable) pair, got {type(params).__name__}"
+                ) from None
+        if dtype == "fp32":
+            if qt is not None:
+                raise ValueError(
+                    "a quantized (quant.npz) table cannot serve with "
+                    "serve_table_dtype=fp32 — set serve_table_dtype to "
+                    f"the checkpoint's dtype ({qt.dtype}) or convert "
+                    "it back (python -m tools.convert_checkpoint "
+                    "<dir> --to fp32)"
+                )
+            placed = fm.FmParams(
+                w0=jax.device_put(
+                    jnp.asarray(params.w0, jnp.float32),
+                    self._param_sh.w0,
+                ),
+                table=jax.device_put(
+                    jnp.asarray(params.table, jnp.float32),
+                    self._param_sh.table,
+                ),
+            )
+            table_bytes = (
+                self.cfg.vocabulary_size * self.cfg.embedding_dim * 4
+            )
+            err = 0.0  # fp32 serving IS the reference
+        else:
+            if qt is None:
+                # Quantize an fp32 checkpoint host-side, off-traffic
+                # (construction or hot-swap staging).
+                w0_in = np.float32(np.asarray(params.w0))
+                table = np.asarray(params.table, np.float32)
+                qt = quant.quantize_table(table, dtype, self._chunk)
+                err = self._probe_quant_error(w0_in, table, qt)
+            else:
+                if qt.dtype != dtype:
+                    raise ValueError(
+                        f"quantized checkpoint is {qt.dtype} but "
+                        f"serve_table_dtype={dtype}; they must match "
+                        "(or convert the checkpoint)"
+                    )
+                if dtype == "int8" and int(qt.chunk) != int(self._chunk):
+                    raise ValueError(
+                        f"quantized checkpoint uses quant_chunk="
+                        f"{qt.chunk} but the server is configured "
+                        f"with quant_chunk={self._chunk}; they must "
+                        "match (scale indexing is chunk-derived)"
+                    )
+                # No fp32 reference in hand (the checkpoint IS the
+                # quantized table): -1 marks the gauge UNKNOWN rather
+                # than leaving a previous placement's number (or a
+                # lying 0) standing — documented in the metric schema.
+                err = -1.0
+            w0d = jax.device_put(
+                jnp.asarray(w0_in, jnp.float32), self._param_sh.w0
+            )
+            if dtype == "bf16":
+                placed = fm.FmParams(
+                    w0=w0d,
+                    table=jax.device_put(
+                        jnp.asarray(qt.codes, jnp.bfloat16),
+                        self._param_sh.table,
+                    ),
+                )
+            else:
+                placed = quant.QuantParams(
+                    w0=w0d,
+                    codes=jax.device_put(
+                        jnp.asarray(qt.codes), self._param_sh.table
+                    ),
+                    scales=jax.device_put(
+                        jnp.asarray(qt.scales, jnp.float32),
+                        NamedSharding(self.mesh, P()),
+                    ),
+                )
+            table_bytes = qt.nbytes
         jax.block_until_ready(placed)
+        self._g_table_bytes.set(int(table_bytes))
+        self._g_quant_err.set(float(err))
         return placed
 
-    def swap(self, params: fm.FmParams, step: int = 0) -> None:
+    def swap(self, params, step: int = 0) -> None:
         """Warm hot-swap: stage the new params into standby device
         buffers (off the dispatch lock — traffic keeps scoring the old
-        table), then swap the reference atomically between dispatches.
-        Shapes are unchanged, so the compiled rungs serve on with zero
-        recompiles; no request ever sees a torn table."""
+        table; a quantized scorer quantizes the incoming fp32 table
+        here too), then swap the reference atomically between
+        dispatches.  Shapes are unchanged, so the compiled rungs serve
+        on with zero recompiles; no request ever sees a torn table."""
         placed = self._place(params)  # standby buffers, fully resident
         with self._swap_lock:
             self._params = placed
@@ -506,10 +677,14 @@ class OverlayScorer(_LadderScorer):
 def load_model(cfg: FmConfig, mesh=None):
     """Load the servable model from ``cfg.model_file``.
 
-    Returns ``("dense", step, device FmParams)`` or ``("tiered", step,
-    (w0, params ColdStore))`` — whichever format the checkpoint
-    directory holds (the two are mutually exclusive; the save paths
-    enforce that).  Raises if neither exists.
+    Returns ``("dense", step, device FmParams)``, ``("tiered", step,
+    (w0, params ColdStore))`` or ``("quant", step, (w0, QuantTable))``
+    — whichever format the checkpoint directory holds (the formats are
+    mutually exclusive; the save paths enforce that).  Raises if none
+    exists.  A quant.npz must match the configured
+    ``serve_table_dtype`` / ``quant_chunk`` — refused loudly on
+    mismatch (scale indexing is chunk-derived; serving a table under
+    the wrong descriptor would silently mis-score).
 
     Dense restores carry the local mesh's TARGET shardings (the same
     template discipline the trainer/old-predict used): orbax places
@@ -532,6 +707,28 @@ def load_model(cfg: FmConfig, mesh=None):
         store = tiered_lib._virtual_store(cfg, "table")
         store.import_overlay(payload)
         return "tiered", step, (float(scalars["w0"]), store)
+    got = checkpoint.restore_quant(cfg.model_file)
+    if got is not None:
+        step, w0, qt = got
+        desc = qt.descriptor()
+        if (
+            desc["vocab"] != cfg.vocabulary_size
+            or desc["dim"] != cfg.embedding_dim
+        ):
+            raise ValueError(
+                f"quantized checkpoint table is [{desc['vocab']}, "
+                f"{desc['dim']}] but the config wants "
+                f"[{cfg.vocabulary_size}, {cfg.embedding_dim}]"
+            )
+        if qt.dtype != cfg.serve_table_dtype:
+            raise ValueError(
+                f"quantized checkpoint at {cfg.model_file} is "
+                f"{qt.dtype} but serve_table_dtype="
+                f"{cfg.serve_table_dtype}; set the knob to the "
+                "checkpoint's dtype or convert it "
+                "(python -m tools.convert_checkpoint)"
+            )
+        return "quant", step, (np.float32(w0), qt)
     if checkpoint.exists(cfg.model_file):
         mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
         param_sh = mesh_lib.param_sharding(mesh)
@@ -562,6 +759,8 @@ def make_scorer(cfg: FmConfig, mesh=None, telemetry=None, writer=None,
             cfg, w0, store, mesh=mesh, telemetry=telemetry,
             writer=writer, extra_rungs=extra_rungs, step=step,
         )
+    # "dense" passes fm.FmParams, "quant" a (w0, QuantTable) pair —
+    # FixedShapeScorer places either per serve_table_dtype.
     return FixedShapeScorer(
         cfg, model, mesh=mesh, telemetry=telemetry, writer=writer,
         extra_rungs=extra_rungs, step=step,

@@ -150,23 +150,45 @@ class CheckpointWatcher:
         man = checkpoint.read_manifest(self._cfg.model_file)
         if man is None or man == self._seen:
             return
-        fmt, step, model = scorer_lib.load_model(
-            self._cfg, mesh=self._scorer.mesh
-        )
-        scorer = self._scorer
-        if fmt == "tiered" and isinstance(
-            scorer, scorer_lib.OverlayScorer
-        ):
-            scorer.swap(*model, step=step)
-        elif fmt == "dense" and isinstance(
-            scorer, scorer_lib.FixedShapeScorer
-        ):
-            scorer.swap(model, step=step)
-        else:
+        try:
+            fmt, step, model = scorer_lib.load_model(
+                self._cfg, mesh=self._scorer.mesh
+            )
+            scorer = self._scorer
+            if fmt == "tiered" and isinstance(
+                scorer, scorer_lib.OverlayScorer
+            ):
+                scorer.swap(*model, step=step)
+            elif fmt in ("dense", "quant") and isinstance(
+                scorer, scorer_lib.FixedShapeScorer
+            ):
+                # A dense checkpoint swaps into any table dtype (a
+                # quantized scorer re-quantizes it off-traffic); a
+                # quant checkpoint must match the scorer's
+                # dtype/chunk — mismatches raise ValueError below.
+                scorer.swap(model, step=step)
+            else:
+                log.warning(
+                    "checkpoint at %s changed FORMAT (%s) mid-serve; "
+                    "a running server cannot cross dense<->tiered — "
+                    "restart to pick it up",
+                    self._cfg.model_file, fmt,
+                )
+                self._seen = man
+                return
+        except ValueError as e:
+            # A ValueError out of load_model/swap is a PERMANENT
+            # config<->checkpoint contradiction (serve_table_dtype or
+            # quant_chunk mismatch, shape mismatch, overlay descriptor
+            # drift) — re-reading a multi-GB table every poll would
+            # never fix it.  Baseline the manifest like the
+            # format-flip branch: warn once, keep serving the current
+            # params, pick up the NEXT save.
             log.warning(
-                "checkpoint at %s changed FORMAT (%s) mid-serve; a "
-                "running server cannot cross dense<->tiered — restart "
-                "to pick it up", self._cfg.model_file, fmt,
+                "checkpoint at %s cannot be served under this config "
+                "(%s); keeping the current params — fix the config or "
+                "republish, a restart is NOT needed for the next "
+                "compatible save", self._cfg.model_file, e,
             )
             self._seen = man
             return
@@ -188,6 +210,12 @@ class ServeServer:
         tel = telemetry if telemetry is not None else obs.NULL
         requests_c = tel.counter("serve.http_requests")
         truncated_c = tel.counter("serve.truncated_features")
+        # Per-request libsvm-text parse time: PR 9 flagged text parsing
+        # as measurable host latency at small requests — this timer
+        # makes it a measured number (/metrics + the bench serve
+        # section) instead of an assumption, and the datum a future
+        # binary transport would be judged against.
+        parse_t = tel.timer("serve.parse")
         server = self
 
         class Handler(QuietHandler):
@@ -227,9 +255,10 @@ class ServeServer:
                     return
                 try:
                     text = self.rfile.read(length).decode()
-                    ids, vals, fields, n, truncated = parse_request(
-                        text, cfg
-                    )
+                    with parse_t.time():
+                        ids, vals, fields, n, truncated = parse_request(
+                            text, cfg
+                        )
                 except (ValueError, UnicodeDecodeError) as e:
                     self._send(
                         400, f"bad request: {e}\n".encode(), "text/plain"
@@ -331,6 +360,7 @@ def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
     block can never disagree with ``stages``)."""
     counters = snap.get("counters") or {}
     timers = snap.get("timers") or {}
+    gauges = snap.get("gauges") or {}
     lat = timers.get("serve.latency") or {}
     requests = int(counters.get("serve.requests", 0))
     out = {
@@ -349,9 +379,27 @@ def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
             counters.get("serve.truncated_features", 0)
         ),
     }
+    # Quantized-table accounting, emitted only when the scorer owns
+    # the gauges (FixedShapeScorer): the device-resident table's real
+    # byte footprint and the max |served_fp32 − served_quant| probe
+    # error from the last placement (0 = fp32 serving IS the
+    # reference, −1 = unknown).  An OverlayScorer registers neither —
+    # defaulting its error to 0 would CLAIM exactness for a quantized
+    # cold store it never measured.
+    if "serve.table_bytes" in gauges:
+        out["table_mb"] = round(
+            gauges["serve.table_bytes"] / (1 << 20), 3
+        )
+    if "serve.quant_error_max" in gauges:
+        out["quant_error_max"] = round(
+            float(gauges["serve.quant_error_max"]), 6
+        )
     for key in ("mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
         if key in lat:
             out[key] = lat[key]
+    parse = timers.get("serve.parse") or {}
+    if "p50_ms" in parse:
+        out["parse_p50_ms"] = parse["p50_ms"]
     return out
 
 

@@ -26,6 +26,8 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from fast_tffm_tpu.ops import quant
+
 log = logging.getLogger(__name__)
 
 
@@ -56,10 +58,21 @@ def save(
         )
         if opt_state is not None:
             ckptr.save(_opt_dir(model_file), {"opt_state": opt_state}, force=True)
-    # The dense dirs are the checkpoint now; a stale tiered overlay left
-    # behind by an earlier table_tiering run must not shadow them (the
-    # tiered restore path checks the overlay FIRST).
+        elif os.path.isdir(_opt_dir(model_file)):
+            # A save WITHOUT optimizer state is the whole checkpoint
+            # (the convert tool's dequantized params): an opt dir left
+            # over from an earlier dense save belongs to DIFFERENT
+            # params, and a later warm start would silently pair the
+            # stale accumulators with the new table.
+            import shutil
+
+            shutil.rmtree(_opt_dir(model_file))
+    # The dense dirs are the checkpoint now; a stale tiered overlay (or
+    # quantized table) left behind by an earlier table_tiering /
+    # convert run must not shadow them (the restore paths check those
+    # formats FIRST).
     clear_tiered(model_file)
+    clear_quant(model_file)
     if data_state is not None:
         # Input-pipeline position for mid-epoch resume; written last so a
         # crash mid-save leaves the (older) params without a newer data
@@ -202,6 +215,7 @@ def save_tiered(
             import shutil
 
             shutil.rmtree(stale)
+    clear_quant(model_file)
     if data_state is not None:
         dtmp = _data_state_path(model_file) + ".tmp"
         with open(dtmp, "w") as f:
@@ -239,6 +253,85 @@ def clear_tiered(model_file: str) -> None:
     are now the checkpoint; precedence must not flip back)."""
     try:
         os.remove(_tiered_path(model_file))
+    except FileNotFoundError:
+        pass
+
+
+# ----------------------------------------------------------------------
+# Dense QUANTIZED checkpoint (quant.npz): bf16 / int8-with-scales table
+# ----------------------------------------------------------------------
+
+
+def _quant_path(model_file: str) -> str:
+    return os.path.join(os.path.abspath(model_file), "quant.npz")
+
+
+def exists_quant(model_file: str) -> bool:
+    return os.path.isfile(_quant_path(model_file))
+
+
+def save_quant(model_file: str, step: int, w0,
+               qt: "quant.QuantTable") -> None:
+    """Dense quantized checkpoint: the serving-oriented compact format
+    (``tools/convert_checkpoint.py`` writes it; the serve ladder loads
+    it as the device-resident table).  Layout:
+    ``<model_file>/quant.npz`` with ``scalar/step``, ``scalar/w0``,
+    ``quant/codes`` (int8, or bf16 as a uint16 bit view),
+    ``quant/scales`` (int8 only) and ``quant/descriptor`` — the JSON
+    format identity (dtype / chunk / vocab / dim) a loader must match
+    or refuse.  The dense params/opt dirs and any tiered overlay are
+    removed: quant.npz is now the checkpoint, and three formats with
+    no shared freshness marker must never coexist.
+    """
+    path = _quant_path(model_file)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        "scalar/step": np.int64(step),
+        "scalar/w0": np.asarray(w0, np.float32),
+        "quant/descriptor": np.array(
+            json.dumps(qt.descriptor(), sort_keys=True)
+        ),
+    }
+    for name, arr in quant.table_to_arrays(qt).items():
+        payload[f"quant/{name}"] = arr
+    tmp = path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+    for stale in (_params_dir(model_file), _opt_dir(model_file)):
+        if os.path.isdir(stale):
+            import shutil
+
+            shutil.rmtree(stale)
+    clear_tiered(model_file)
+    _publish_manifest(model_file, step, "quant")
+    log.info(
+        "saved %s quantized checkpoint step=%d to %s",
+        qt.dtype, step, path,
+    )
+
+
+def restore_quant(model_file: str) -> Optional[tuple]:
+    """(step, w0, QuantTable) from quant.npz, or None."""
+    path = _quant_path(model_file)
+    if not os.path.isfile(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        step = int(z["scalar/step"])
+        w0 = float(z["scalar/w0"])
+        descriptor = json.loads(str(z["quant/descriptor"]))
+        arrays = {
+            k.split("/", 1)[1]: z[k]
+            for k in z.files
+            if k.startswith("quant/") and k != "quant/descriptor"
+        }
+    return step, w0, quant.table_from_arrays(descriptor, arrays)
+
+
+def clear_quant(model_file: str) -> None:
+    """Remove a stale quant.npz after a dense/tiered-format save."""
+    try:
+        os.remove(_quant_path(model_file))
     except FileNotFoundError:
         pass
 

@@ -628,6 +628,18 @@ class Trainer:
                 "for the dense format); resume it with table_tiering=on, "
                 "or point model_file somewhere fresh to train dense"
             )
+        if checkpoint.exists_quant(cfg.model_file):
+            # Same refusal discipline for the quantized serving format:
+            # training warm-starts want full-precision params (and the
+            # quantized table carries no optimizer state) — silently
+            # cold-starting over it would discard a model.
+            raise ValueError(
+                f"{cfg.model_file} holds a quantized serving checkpoint "
+                "(quant.npz); training cannot warm-start from it — "
+                "convert it back to the dense format first "
+                "(python -m tools.convert_checkpoint <dir> --to fp32), "
+                "or point model_file somewhere fresh"
+            )
         template = _params_template(cfg, param_sh)
         opt_sh = self._opt_shardings(param_sh, template)
         opt_init = jax.jit(self._opt_init_fn, out_shardings=opt_sh)
@@ -680,6 +692,13 @@ class Trainer:
         def put_scalar(x):
             return jax.device_put(jnp.asarray(x, jnp.float32), rep)
 
+        if checkpoint.exists_quant(cfg.model_file):
+            raise ValueError(
+                f"{cfg.model_file} holds a quantized serving checkpoint "
+                "(quant.npz); a tiered trainer cannot warm-start from "
+                "it — convert it back to the dense format first "
+                "(python -m tools.convert_checkpoint <dir> --to fp32)"
+            )
         overlay = checkpoint.restore_tiered(cfg.model_file)
         if overlay is not None:
             step, scalars, stores = overlay
@@ -1311,6 +1330,7 @@ class Trainer:
                 "hot_rows": (
                     cfg.hot_rows if cfg.table_tiering == "on" else 0
                 ),
+                "cold_dtype": cfg.cold_dtype,
                 "batch_size": cfg.batch_size,
                 "epoch_num": cfg.epoch_num,
                 "optimizer": cfg.optimizer,

@@ -62,6 +62,7 @@ from typing import NamedTuple, Optional
 import numpy as np
 
 from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.ops import quant
 
 log = logging.getLogger(__name__)
 
@@ -183,32 +184,53 @@ class ColdStore:
     - virtual: ``init_rows(ids) -> [n, dim]`` computes any row on demand
       and a sorted (ids, rows) overlay holds every row ever written.
       Memory scales with written rows, not vocab.
+
+    Storage format: rows live PACKED through an
+    :class:`ops.quant.RowCodec` (``cold_dtype``): fp32 is the identity
+    codec (bit-exact, the historical behavior), bf16/int8 store
+    compact packed rows — encoded on every write (scatter /
+    write-back), decoded on every read (gather / hot-load).  The
+    overlay machinery never looks inside a row, so it is entirely
+    dtype-agnostic; ``nbytes`` reports the real compact footprint.
     """
 
     def __init__(self, vocab: int, dim: int, descriptor: dict,
-                 init_rows=None, dense: Optional[np.ndarray] = None):
+                 init_rows=None, dense: Optional[np.ndarray] = None,
+                 codec: Optional[quant.RowCodec] = None):
         self.vocab = vocab
         self.dim = dim
         self.descriptor = dict(descriptor)
         self._init_rows = init_rows
+        self._codec = codec if codec is not None else quant.RowCodec(
+            "fp32", dim
+        )
         self._dense = dense
         # Sorted sparse overlay (virtual mode): _ids ascending, _rows[i]
-        # is the stored value of row _ids[i].  Writes land in an
-        # unsorted TAIL of (sorted ids, rows) batches first and merge
+        # is the stored (packed) value of row _ids[i].  Writes land in
+        # an unsorted TAIL of (sorted ids, rows) batches first and merge
         # into the main arrays only when the tail outgrows a fraction of
         # them — rebuilding the whole overlay per write-back flush would
         # be O(written_rows) per super-batch (quadratic over a run).
         self._ids = np.empty((0,), np.int64)
-        self._rows = np.empty((0, dim), np.float32)
+        self._rows = self._codec.empty(0)
         self._tail: list = []  # [(sorted unique ids, rows), ...] newest last
         self._tail_n = 0
 
+    @property
+    def cold_dtype(self) -> str:
+        return self._codec.dtype
+
     @classmethod
-    def from_dense(cls, arr: np.ndarray, descriptor: dict) -> "ColdStore":
+    def from_dense(cls, arr: np.ndarray, descriptor: dict,
+                   codec: Optional[quant.RowCodec] = None) -> "ColdStore":
+        vocab, dim = arr.shape
+        if codec is not None and codec.dtype != "fp32":
+            return cls(vocab, dim, descriptor, dense=codec.encode(arr),
+                       codec=codec)
         arr = np.ascontiguousarray(arr, dtype=np.float32)
         if not arr.flags.writeable:  # np.asarray(jax_array) is read-only
             arr = arr.copy()
-        return cls(arr.shape[0], arr.shape[1], descriptor, dense=arr)
+        return cls(vocab, dim, descriptor, dense=arr, codec=codec)
 
     @property
     def dense_backed(self) -> bool:
@@ -230,22 +252,26 @@ class ColdStore:
         self._compact()
         return len(self._ids)
 
-    @staticmethod
-    def _overlay(out, ids, o_ids, o_rows) -> None:
-        """out[k] = o_rows[j] wherever ids[k] == o_ids[j] (o_ids sorted)."""
+    def _overlay(self, out, ids, o_ids, o_rows) -> None:
+        """out[k] = decode(o_rows[j]) wherever ids[k] == o_ids[j]
+        (o_ids sorted; ``out`` is f32)."""
         if not len(o_ids):
             return
         pos = np.searchsorted(o_ids, ids)
         pos_c = np.minimum(pos, len(o_ids) - 1)
         hit = o_ids[pos_c] == ids
         if hit.any():
-            out[hit] = o_rows[pos_c[hit]]
+            out[hit] = self._codec.decode(o_rows[pos_c[hit]])
 
     def gather(self, ids: np.ndarray) -> np.ndarray:
-        """Current value of each logical row (written value, else init)."""
+        """Current f32 value of each logical row (written value, else
+        init) — quantized stores dequantize on the way out (the
+        hot-load path)."""
         ids = ids.astype(np.int64, copy=False)
         if self._dense is not None:
-            return self._dense[ids]  # fancy indexing: already a copy
+            # Fancy indexing is already a copy; fp32's decode is the
+            # identity on it.
+            return self._codec.decode(self._dense[ids])
         out = self._init_rows(ids)
         self._overlay(out, ids, self._ids, self._rows)
         for t_ids, t_rows in self._tail:  # newest last = newest wins
@@ -253,17 +279,36 @@ class ColdStore:
         return out
 
     def scatter(self, ids: np.ndarray, rows: np.ndarray) -> None:
-        """Write rows (ids unique) into the store."""
+        """Write f32 rows (ids unique) into the store — quantized
+        stores re-encode on the way in (the write-back path)."""
         if not len(ids):
             return
         ids = ids.astype(np.int64, copy=False)
-        if self._dense is not None:
+        if self._dense is not None and self._codec.dtype == "fp32":
             self._dense[ids] = rows
             return
+        self._store_packed(
+            ids, self._codec.encode(np.asarray(rows, np.float32))
+        )
+
+    def _store_packed(self, ids: np.ndarray, packed: np.ndarray) -> None:
+        """Write already-packed rows (the overlay-restore path — no
+        decode/re-encode round trip, so a checkpointed row restores
+        bit-exactly whatever the codec)."""
+        if packed.shape[1:] != (self._codec.width,):
+            raise ValueError(
+                f"packed rows have width {packed.shape[1:]} but this "
+                f"{self._codec.dtype} store expects "
+                f"[{self._codec.width}]"
+            )
+        if self._dense is not None:
+            self._dense[ids] = packed
+            return
         order = np.argsort(ids, kind="stable")
-        self._tail.append((ids[order].copy(), np.asarray(
-            rows, np.float32
-        )[order].copy()))
+        self._tail.append((
+            ids[order].copy(),
+            np.ascontiguousarray(packed[order]),
+        ))
         self._tail_n += len(ids)
         if self._tail_n > max(4096, len(self._ids) // 2):
             self._compact()
@@ -287,8 +332,9 @@ class ColdStore:
         self._tail_n = 0
 
     def to_dense(self) -> np.ndarray:
-        """The full logical array (dense checkpoint / merged eval); only
-        legal for dense-backed or small-enough virtual stores."""
+        """The full logical array as f32 (dense checkpoint / merged
+        eval); only legal for dense-backed or small-enough virtual
+        stores."""
         if self._dense is None:
             if self.vocab * self.dim * 4 > EXACT_BYTES_MAX:
                 raise ValueError(
@@ -299,14 +345,20 @@ class ColdStore:
             self._compact()
             dense = self._init_rows(np.arange(self.vocab, dtype=np.int64))
             if len(self._ids):
-                dense[self._ids] = self._rows
-            self._dense = dense
+                dense[self._ids] = self._codec.decode(self._rows)
+            self._dense = (
+                dense if self._codec.dtype == "fp32"
+                else self._codec.encode(dense)
+            )
             self._ids = np.empty((0,), np.int64)
-            self._rows = np.empty((0, self.dim), np.float32)
-        return self._dense
+            self._rows = self._codec.empty(0)
+        return self._codec.decode(self._dense)
 
     def export(self) -> dict:
-        """Sparse overlay payload for the tiered checkpoint format."""
+        """Sparse overlay payload for the tiered checkpoint format.
+        ``rows`` is the PACKED storage array (codec-specific width) —
+        the descriptor's dtype names the format, and a restore stores
+        the packed rows verbatim (no decode/re-encode drift)."""
         if self._dense is not None:
             raise ValueError(
                 "dense-backed cold stores checkpoint in the dense format"
@@ -317,24 +369,34 @@ class ColdStore:
     def import_overlay(self, payload: dict) -> None:
         ids = payload["ids"].astype(np.int64, copy=False)
         if len(ids):
-            self.scatter(ids, payload["rows"].astype(np.float32, copy=False))
+            self._store_packed(
+                ids,
+                np.asarray(payload["rows"], self._codec.storage_dtype),
+            )
 
 
 def _virtual_descriptor(cfg: FmConfig, name: str) -> dict:
     if name == "table":
-        return {"kind": "uniform", "seed": cfg.seed,
+        desc = {"kind": "uniform", "seed": cfg.seed,
                 "range": cfg.init_value_range}
-    if name in ("acc", "n"):
-        return {"kind": "const", "value": cfg.adagrad_initial_accumulator}
-    if name == "z":
+    elif name in ("acc", "n"):
+        desc = {"kind": "const", "value": cfg.adagrad_initial_accumulator}
+    elif name == "z":
         denom0 = float(
             (cfg.ftrl_beta + np.sqrt(cfg.adagrad_initial_accumulator))
             / cfg.learning_rate + cfg.ftrl_l2
         )
-        return {"kind": "ftrl_z", "seed": cfg.seed,
+        desc = {"kind": "ftrl_z", "seed": cfg.seed,
                 "range": cfg.init_value_range, "denom0": denom0,
                 "l1": cfg.ftrl_l1}
-    raise ValueError(f"unknown store {name!r}")
+    else:
+        raise ValueError(f"unknown store {name!r}")
+    # Storage-format identity rides the descriptor (empty for fp32, so
+    # pre-quantization checkpoints keep matching byte-for-byte): an
+    # overlay written under one cold_dtype refuses to restore under
+    # another — its packed rows are not the other format's bytes.
+    desc.update(quant.cold_codec(cfg).descriptor())
+    return desc
 
 
 def _virtual_store(cfg: FmConfig, name: str) -> ColdStore:
@@ -359,7 +421,8 @@ def _virtual_store(cfg: FmConfig, name: str) -> ColdStore:
         def init_rows(ids):
             p = _hash_uniform(ids, dim, seed, r)
             return -p * denom0 - np.sign(p) * l1
-    return ColdStore(vocab, dim, desc, init_rows=init_rows)
+    return ColdStore(vocab, dim, desc, init_rows=init_rows,
+                     codec=quant.cold_codec(cfg))
 
 
 def _exact_stores(cfg: FmConfig, names: tuple,
@@ -378,15 +441,19 @@ def _exact_stores(cfg: FmConfig, names: tuple,
         params = fm.FmParams(
             w0=np.zeros((), np.float32), table=params_table
         )
+    codec = quant.cold_codec(cfg)
     stores = {
-        "table": ColdStore.from_dense(params_table, {"kind": "exact"})
+        "table": ColdStore.from_dense(
+            params_table, {"kind": "exact", **codec.descriptor()}, codec
+        )
     }
     opt_names = tuple(n for n in names if n != "table")
     if opt_names:
         opt = sparse_lib.init_sparse_opt_state(cfg, params)
         for name, tab in zip(opt_names, get_opt_tables(cfg.optimizer, opt)):
             stores[name] = ColdStore.from_dense(
-                np.asarray(tab), {"kind": "exact"}
+                np.asarray(tab), {"kind": "exact", **codec.descriptor()},
+                codec,
             )
     return stores
 
@@ -451,6 +518,7 @@ class TieredTable:
         self.vocab = cfg.vocabulary_size
         self.hot_rows = min(cfg.hot_rows, cfg.vocabulary_size)
         self.dim = cfg.embedding_dim
+        self.codec = quant.cold_codec(cfg)
         self.names = ("table",) + opt_table_names(cfg.optimizer)
         self._cv = threading.Condition(threading.RLock())
         self.slot_of = np.full(self.vocab, _NEVER, np.int32)
@@ -494,6 +562,7 @@ class TieredTable:
 
     def _build_stores(self, dense_tables, overlay) -> tuple:
         cfg = self.cfg
+        codec = quant.cold_codec(cfg)
         exact = self.vocab * self.dim * 4 <= EXACT_BYTES_MAX
         if dense_tables is not None:
             # Warm start from a dense checkpoint (always small V).  Any
@@ -501,7 +570,9 @@ class TieredTable:
             # params — same semantics as the dense path's opt_init on
             # restored params.
             stores = {
-                name: ColdStore.from_dense(arr, {"kind": "restored"})
+                name: ColdStore.from_dense(
+                    arr, {"kind": "restored"}, codec
+                )
                 for name, arr in dense_tables.items()
             }
             missing = [n for n in self.names if n not in stores]
@@ -519,10 +590,7 @@ class TieredTable:
         if overlay is not None:
             for name in self.names:
                 payload = overlay[name]
-                want = (
-                    built[name].descriptor if not built[name].dense_backed
-                    else {"kind": "exact"}
-                )
+                want = built[name].descriptor
                 got = payload.get("descriptor")
                 if got is not None and got != want:
                     raise ValueError(
@@ -876,6 +944,13 @@ class TieredTable:
                     0 if self.stores[0].dense_backed
                     else self.stores[0].written_rows
                 ),
+                # Storage-format identity of the cold rows: the dtype
+                # string is for report readers (non-numeric values are
+                # skipped by /metrics), the bytes-per-row gauge is the
+                # compaction factor the bench's quantized_table section
+                # compares across dtypes (fp32 = 4 * D).
+                "cold_dtype": self.codec.dtype,
+                "cold_bytes_per_row": int(self.codec.bytes_per_row),
             }
 
     def health_view(self) -> dict:

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Checkpoint dtype converter: dense fp32 <-> quantized (bf16 / int8).
+
+Existing fp32 checkpoints migrate to the compact serving formats (and
+back) without retraining:
+
+    python -m tools.convert_checkpoint ./fm_model --to int8 --out ./m8
+    python -m tools.convert_checkpoint ./m8 --to fp32 --out ./m32
+
+A LOSSY in-place conversion (``--to bf16/int8`` without ``--out``)
+deletes the full-precision params and optimizer state — recoverable
+only as dequantized values — so it refuses unless ``--force`` says
+you mean it.
+
+Reads either the dense Orbax checkpoint (``<dir>/params``) or a
+quantized ``<dir>/quant.npz``; writes the requested format via the
+same ``train.checkpoint`` save paths the trainer uses — so precedence
+stays single-format (a quant save removes the dense dirs and vice
+versa) and the serving manifest republishes, meaning a running server
+watching the directory hot-swaps onto the converted table at its next
+poll.
+
+fp32 -> bf16/int8 is lossy (that is the point); int8 uses symmetric
+per-chunk scales (``--chunk`` consecutive rows share one fp32 scale,
+matching the ``quant_chunk`` knob — a server must be configured with
+the same value).  The tool prints the max |dequant - fp32| element
+error and the table bytes before/after.  bf16/int8 -> fp32 dequantizes
+into an ordinary dense checkpoint a trainer can warm-start from
+(training never warm-starts from quant.npz directly — it refuses, and
+points here).
+
+Tiered ``tiered.npz`` overlays are NOT convertible here: their rows
+are deltas over a deterministic init bound to the training config
+(seed / init range / cold_dtype) — re-encoding them would silently
+redefine every never-written row.  Retrain with the desired
+``cold_dtype``, or merge to dense at a small vocabulary first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def _load_fp32(model_file: str):
+    """(step, w0 f32, table f32 [V, D]) from dense or quant format."""
+    from fast_tffm_tpu.ops import quant
+    from fast_tffm_tpu.train import checkpoint
+
+    if checkpoint.exists_tiered(model_file):
+        raise SystemExit(
+            f"{model_file} holds a tiered overlay (tiered.npz): overlay "
+            "rows are bound to the training config's deterministic init "
+            "and cannot be dtype-converted standalone — retrain with "
+            "the desired cold_dtype, or merge to dense first"
+        )
+    got = checkpoint.restore_quant(model_file)
+    if got is not None:
+        step, w0, qt = got
+        return step, np.float32(w0), quant.dequantize_table(qt), qt.dtype
+    if not checkpoint.exists(model_file):
+        raise SystemExit(
+            f"no convertible checkpoint at {model_file} (neither the "
+            "dense params dir nor quant.npz)"
+        )
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        raw = ckptr.restore(checkpoint._params_dir(model_file))
+    step = int(np.asarray(raw["step"]))
+    params = raw["params"]
+    if isinstance(params, dict):
+        w0, table = params["w0"], params["table"]
+    else:  # restored as a sequence (w0, table)
+        w0, table = params[0], params[1]
+    return step, np.asarray(w0, np.float32), np.asarray(
+        table, np.float32
+    ), "fp32"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="convert a checkpoint between fp32 and the "
+                    "quantized (bf16/int8) dense formats"
+    )
+    ap.add_argument("model_file", help="checkpoint directory")
+    ap.add_argument("--to", required=True,
+                    choices=["fp32", "bf16", "int8"], dest="to_dtype",
+                    help="target table dtype")
+    ap.add_argument("--out", default=None,
+                    help="output checkpoint directory (default: convert "
+                         "in place)")
+    ap.add_argument("--chunk", type=int, default=64,
+                    help="int8 scale chunk: this many consecutive rows "
+                         "share one fp32 scale (0 = per-row; must match "
+                         "the server's quant_chunk)")
+    ap.add_argument("--force", action="store_true",
+                    help="allow a LOSSY conversion to overwrite its "
+                         "own source (in-place --to bf16/int8 deletes "
+                         "the fp32 params and optimizer state)")
+    args = ap.parse_args(argv)
+
+    in_place = args.out is None or (
+        os.path.abspath(args.out) == os.path.abspath(args.model_file)
+    )
+    if args.to_dtype != "fp32" and in_place and not args.force:
+        raise SystemExit(
+            "refusing to quantize IN PLACE: this deletes the fp32 "
+            "params and optimizer state (only dequantized values "
+            "would remain).  Write to a new directory with --out, or "
+            "pass --force if you really mean to overwrite"
+        )
+
+    from fast_tffm_tpu.models import fm
+    from fast_tffm_tpu.ops import quant
+    from fast_tffm_tpu.train import checkpoint
+
+    step, w0, table, src_dtype = _load_fp32(args.model_file)
+    out = args.out if args.out is not None else args.model_file
+    src_bytes = table.nbytes if src_dtype == "fp32" else None
+    print(
+        f"loaded {src_dtype} checkpoint step={step} "
+        f"table=[{table.shape[0]}, {table.shape[1]}] from "
+        f"{args.model_file}"
+    )
+    if args.to_dtype == "fp32":
+        checkpoint.save(
+            out, step, fm.FmParams(w0=w0, table=table), opt_state=None
+        )
+        print(
+            f"wrote dense fp32 checkpoint ({table.nbytes >> 20} MiB "
+            f"table) to {out}"
+        )
+        if src_dtype != "fp32":
+            print(
+                "note: a trainer warm-starting from this table resumes "
+                "the DEQUANTIZED values (optimizer state reinitializes)"
+            )
+        return 0
+    qt = quant.quantize_table(table, args.to_dtype, args.chunk)
+    # Max element error in row blocks: dequantizing the whole [V, D]
+    # table just to print one number would double-to-triple peak RSS
+    # at real vocabularies (same hazard class the serve probe avoids
+    # via quant.dequantize_rows).
+    err, block = 0.0, 1 << 20
+    for i in range(0, len(table), block):
+        ids = np.arange(i, min(i + block, len(table)))
+        err = max(err, float(np.abs(
+            quant.dequantize_rows(qt, ids) - table[ids]
+        ).max()))
+    checkpoint.save_quant(out, step, w0, qt)
+    ratio = (src_bytes or table.nbytes) / max(1, qt.nbytes)
+    print(
+        f"wrote {args.to_dtype} quant.npz to {out}: table "
+        f"{table.nbytes} -> {qt.nbytes} bytes ({ratio:.2f}x smaller), "
+        f"max |dequant - fp32| element error {err:.3e}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

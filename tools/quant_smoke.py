@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Quantized-table smoke: the end-to-end migration story, through the
+real CLI — train with a bf16 cold store, score the fp32 reference
+offline, CONVERT the checkpoint to int8, serve it quantized over the
+socket, and tolerance-check the served scores against fp32.
+
+    train (table_tiering=on, cold_dtype=bf16, ~20 steps)
+      -> dense checkpoint (small-V merge)
+      -> predict: fp32 reference scores (score_path)
+      -> python -m tools.convert_checkpoint --to int8  (quant.npz)
+      -> run_tffm.py serve --serve_table_dtype int8
+      -> POST /score == fp32 scores within tolerance, and
+         tffm_gauge_serve_table_bytes / _quant_error_max on /metrics
+
+Run by tools/verify.sh after the observability smoke.  Exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Served int8 vs fp32 tolerance on sigmoid outputs.  The pinned unit
+# tolerance (tests/test_quant.py) is 2e-2 at adversarial magnitudes;
+# this freshly-trained tiny model sits far inside it.
+TOL = 5e-2
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _gen_data(path: str, n_lines: int = 640, vocab: int = 64) -> None:
+    import numpy as np
+
+    rng = np.random.default_rng(21)
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            ids = rng.choice(vocab, 3, replace=False)
+            f.write(
+                f"{rng.integers(0, 2)} " + " ".join(
+                    f"{i}:{rng.uniform(0.1, 1.0):.3f}" for i in ids
+                ) + "\n"
+            )
+
+
+def _run_cli(args, what: str) -> str:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable] + args, cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=300,
+    )
+    out = proc.stdout.decode(errors="replace")
+    if proc.returncode != 0:
+        sys.stderr.write(out[-3000:])
+        raise SystemExit(f"FAIL: {what} exited {proc.returncode}")
+    return out
+
+
+def _wait_serving(base: str, proc) -> None:
+    deadline = time.time() + 120
+    while True:
+        try:
+            urllib.request.urlopen(f"{base}/healthz", timeout=2)
+            return
+        except (urllib.error.URLError, OSError) as e:
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                sys.stderr.write(out.decode(errors="replace")[-3000:])
+                raise SystemExit(
+                    f"FAIL: serve exited {proc.returncode} early ({e})"
+                )
+            if time.time() > deadline:
+                raise SystemExit(f"FAIL: serve unreachable ({e})")
+            time.sleep(0.2)
+
+
+def _run(tmpdir: str) -> int:
+    data = os.path.join(tmpdir, "train.libsvm")
+    _gen_data(data)
+    model = os.path.join(tmpdir, "model")
+    scores_path = os.path.join(tmpdir, "scores.txt")
+    cfg_path = os.path.join(tmpdir, "quant_smoke.cfg")
+    with open(cfg_path, "w") as f:
+        f.write(f"""[General]
+vocabulary_size = 64
+factor_num = 4
+model_file = {model}
+[Train]
+train_files = {data}
+epoch_num = 1
+batch_size = 32
+log_steps = 0
+thread_num = 2
+[Predict]
+predict_files = {data}
+score_path = {scores_path}
+[Tpu]
+max_features = 4
+table_tiering = on
+hot_rows = 60
+cold_dtype = bf16
+""")
+    run_tffm = os.path.join(REPO, "run_tffm.py")
+    # 640 lines / batch 32 = 20 training steps with a quantized (bf16)
+    # cold store and eviction churn (hot_rows < vocab); small V merges
+    # to the DENSE checkpoint format on save.
+    _run_cli([run_tffm, "train", cfg_path], "bf16-cold training")
+    # fp32 reference scores through the offline ladder (same scorer
+    # the server mounts).
+    _run_cli([run_tffm, "predict", cfg_path], "fp32 predict")
+    with open(scores_path) as f:
+        ref = [float(s) for s in f.read().split()]
+    if len(ref) != 640:
+        raise SystemExit(f"FAIL: predict wrote {len(ref)} scores")
+    # Convert the dense checkpoint to the int8 serving format in place
+    # (--force: in-place lossy conversion is refused without it, and
+    # this throwaway smoke checkpoint is exactly the case it exists
+    # for).
+    _run_cli(
+        ["-m", "tools.convert_checkpoint", model, "--to", "int8",
+         "--force"],
+        "fp32 -> int8 conversion",
+    )
+    if not os.path.isfile(os.path.join(model, "quant.npz")):
+        raise SystemExit("FAIL: conversion left no quant.npz")
+    # Serve the quantized table and score the first 10 examples over
+    # the socket.
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, run_tffm, "serve", cfg_path,
+         "--serve_port", str(port), "--serve_table_dtype", "int8",
+         "--serve_poll_secs", "0"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        _wait_serving(base, proc)
+        with open(data) as f:
+            lines = "".join(f.readline() for _ in range(10))
+        body = urllib.request.urlopen(urllib.request.Request(
+            f"{base}/score", data=lines.encode(), method="POST"
+        ), timeout=60).read().decode()
+        served = [float(s) for s in body.split()]
+        if len(served) != 10:
+            raise SystemExit(
+                f"FAIL: served {len(served)} scores for 10 examples"
+            )
+        worst = max(abs(s - r) for s, r in zip(served, ref[:10]))
+        if worst > TOL:
+            raise SystemExit(
+                f"FAIL: served int8 scores drift {worst:.4f} from the "
+                f"fp32 reference (tolerance {TOL})"
+            )
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=10
+        ).read().decode()
+        for series in ("tffm_gauge_serve_table_bytes",
+                       "tffm_serve_table_mb",
+                       "tffm_serve_quant_error_max"):
+            if series not in metrics:
+                raise SystemExit(
+                    f"FAIL: /metrics missing quant series {series}"
+                )
+        print(
+            f"ok: trained bf16-cold, converted to int8, served "
+            f"quantized — max |served - fp32| = {worst:.5f} "
+            f"(tolerance {TOL})"
+        )
+        return 0
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
+def main() -> int:
+    tmpdir = tempfile.mkdtemp(prefix="tffm_quant_smoke_")
+    try:
+        return _run(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,8 +219,9 @@ def _print_breakdown(rec: dict) -> None:
         print("\nserving (latency under load):")
         for key in ("requests", "examples", "batches", "qps",
                     "p50_ms", "p95_ms", "p99_ms", "max_ms",
-                    "batch_fill", "swaps", "compiles",
-                    "steady_compiles", "recompiles_unexpected"):
+                    "parse_p50_ms", "batch_fill", "swaps", "compiles",
+                    "steady_compiles", "recompiles_unexpected",
+                    "table_mb", "quant_error_max"):
             if key in serve:
                 print(f"  {key:22s} {serve[key]}")
         if serve.get("steady_compiles"):
@@ -808,6 +809,19 @@ _DIRECTION_OVERRIDES = {
     "serve_steady_compiles": "low", "serve.steady_compiles": "low",
     "serve.recompiles_unexpected": "low",
     "serve.requests": None, "serve.swaps": None, "serve.compiles": None,
+    # Quantized tables (PR 11): table bytes regress when they RISE
+    # (compactness is the feature), quant error when it RISES (served
+    # scores drifting from fp32), and the quantized step-rate fraction
+    # (dtype rate / fp32 rate at the bench tiered config) when it
+    # FALLS — quantization must buy bytes, not cost throughput.  The
+    # per-section _frac/_mb spellings need overrides because the
+    # suffix heuristics miss or misread them.
+    "serve_table_mb": "low", "serve.table_mb": "low",
+    "serve_quant_error_max_int8": "low", "serve.quant_error_max": "low",
+    "quant_table_bytes_frac_bf16": "low",
+    "quant_table_bytes_frac_int8": "low",
+    "quant_step_rate_frac_bf16": "high",
+    "quant_step_rate_frac_int8": "high",
     # Static-analysis cleanliness (PR 10): bench preflight runs
     # `python -m tools.lint` and records the NEW-finding count — a PR
     # that introduces one regresses the bench compare like any perf

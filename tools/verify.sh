@@ -41,6 +41,14 @@ echo "== live observability + serving smoke (tools/obs_smoke.py) =="
 JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 
 echo
+echo "== quantized-table smoke (tools/quant_smoke.py) =="
+# The migration story end-to-end through the real CLI: train with a
+# bf16 cold store (~20 steps), predict the fp32 reference, convert the
+# checkpoint to int8 (tools/convert_checkpoint), serve it quantized,
+# and tolerance-check the served scores against fp32 over the socket.
+JAX_PLATFORMS=cpu python tools/quant_smoke.py || exit 1
+
+echo
 echo "== tier-1 pytest (pinned invocation from ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log
