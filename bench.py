@@ -47,6 +47,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -924,6 +925,34 @@ def _bench_serve(workers: int) -> dict:
             )
             return out
         arr = np.array(lats) * 1e3
+        # Binary-transport probe: the same mixed-size traffic shape
+        # over POST /score_bin.  serve.parse_bin times the per-request
+        # frame decode exactly where serve.parse times the text parse,
+        # so serve_bin_p50_ms vs serve_parse_p50_ms is the measured
+        # host cost the binary transport removes from the hot path.
+        from fast_tffm_tpu.serve import wire as _wire
+
+        bin_frames = []
+        for n in sizes * 4:
+            b_ids = rng.integers(
+                0, cfg.vocabulary_size, (n, 12)
+            ).astype(np.int32)
+            b_vals = rng.uniform(0.1, 1.0, (n, 12)).astype(np.float32)
+            bin_frames.append(_wire.encode_bin_request(b_ids, b_vals))
+        bin_url = f"http://127.0.0.1:{server.port}/score_bin"
+        bin_errors = []
+        for frame in bin_frames * 3:
+            try:
+                _rq.urlopen(_rq.Request(
+                    bin_url, data=frame, method="POST",
+                    headers={"Content-Type":
+                             "application/octet-stream"},
+                ), timeout=30).read()
+            except Exception as e:  # noqa: BLE001 - report below
+                bin_errors.append(f"{type(e).__name__}: {e}")
+                break
+        if bin_errors:
+            out["bin_probe_error"] = bin_errors[0]
         snap = tel.snapshot()
         counters = snap.get("counters", {})
         gauges = snap.get("gauges", {})
@@ -977,6 +1006,9 @@ def _bench_serve(workers: int) -> dict:
             "serve_parse_p50_ms": float(
                 (timers.get("serve.parse") or {}).get("p50_ms", 0.0)
             ),
+            "serve_bin_p50_ms": float(
+                (timers.get("serve.parse_bin") or {}).get("p50_ms", 0.0)
+            ),
         })
         if errors:
             out["client_errors"] = errors[:5]
@@ -991,6 +1023,267 @@ def _bench_serve(workers: int) -> dict:
             server.close()
         if batcher is not None:
             batcher.close()
+    return out
+
+
+def _bench_serve_router(workers: int) -> dict:
+    """Scale-out serving section: the 2-replica router fleet under
+    concurrent load, then under a 4x-offered-load burst.
+
+    Three numbers are the point (ROADMAP direction 3):
+
+    - ``serve_router_qps`` vs the single-process section's
+      ``serve_qps`` — does throughput scale with processes (the main
+      wiring records the ratio as ``serve_router_scaleout_x``; on a
+      1-core box the replicas share the core, so judge the ratio on a
+      multi-core host);
+    - ``serve_shed_frac`` under the burst — overload must produce fast
+      429s, not an unbounded queue;
+    - ``serve_burst_p99_ms`` — the ADMITTED-request tail under 4x
+      offered load; graceful degradation means it stays within ~2x the
+      unloaded ``serve_router_p99_ms`` instead of collapsing.
+
+    Replicas are REAL subprocesses (shared-nothing: their own jax
+    runtimes, own ports) against a checkpoint this section saves; the
+    router runs in-process.
+    """
+    import shutil as _sh
+    import tempfile as _tf
+    import threading as _th
+    import urllib.request as _rq
+
+    from fast_tffm_tpu.config import FmConfig, load_config
+    from fast_tffm_tpu.models import fm as _fm
+    from fast_tffm_tpu.serve import router as _router
+    from fast_tffm_tpu.train import checkpoint as _ckpt
+
+    import jax as _jax
+
+    out: dict = {"completed": False}
+    handle = None
+    tmpdir = _tf.mkdtemp(prefix="tffm_bench_router_")
+    try:
+        model_dir = os.path.join(tmpdir, "model")
+        gen_cfg = FmConfig(
+            vocabulary_size=1 << 20, factor_num=8, max_features=39,
+            batch_size=1024, model_file=model_dir,
+        )
+        params = _jax.jit(
+            lambda k: _fm.init_params(k, cfg=gen_cfg)
+        )(_jax.random.PRNGKey(3))
+        _ckpt.save(
+            model_dir, 1,
+            _fm.FmParams(*[np.asarray(x) for x in params]),
+        )
+        cfg_path = os.path.join(tmpdir, "serve.cfg")
+        # 15 ms deadline budget: ~the unloaded p99 (admitted requests
+        # stay bounded near it), far below the seconds-long queues a
+        # 4x overload would otherwise build.
+        with open(cfg_path, "w") as f:
+            f.write(f"""[General]
+vocabulary_size = {1 << 20}
+factor_num = 8
+model_file = {model_dir}
+[Train]
+batch_size = 1024
+[Predict]
+serve_replicas = 2
+serve_shed_deadline_ms = 15
+serve_poll_secs = 0
+[Tpu]
+max_features = 39
+""")
+        cfg = load_config(cfg_path)
+        handle = _router.start_fleet(cfg, cfg_path, port=0)
+        url = f"http://127.0.0.1:{handle.port}/score"
+        rng = np.random.default_rng(7)
+
+        def make_bodies(sizes):
+            rendered = []
+            for n in sizes:
+                lines = []
+                for _ in range(n):
+                    ids = rng.integers(0, cfg.vocabulary_size, 12)
+                    lines.append("0 " + " ".join(
+                        f"{i}:{rng.uniform(0.1, 1.0):.3f}" for i in ids
+                    ))
+                rendered.append(("\n".join(lines) + "\n").encode())
+            return rendered
+
+        # Unloaded window: the online mixed-size shape (same as the
+        # single-replica section).  Burst window: max-rung-heavy bodies
+        # so 4x the client concurrency genuinely exceeds fleet
+        # capacity — overload must come from offered WORK, not from
+        # client-thread count.
+        bodies = make_bodies([1, 1, 2, 4, 4, 8, 16, 32, 64] * 4)
+        burst_bodies = make_bodies([64] * 8 + [32] * 2)
+        lat_lock = _th.Lock()
+
+        import http.client as _hc
+
+        router_port = handle.port
+
+        def window(n_clients: int, duration: float, bodies):
+            """Closed-loop client window over PERSISTENT keep-alive
+            connections (a latency-path client does not reconnect per
+            request, and the router keeps 429s on the same
+            connection); returns (ok_lats_ms, shed, errors, wall)."""
+            lats: list = []
+            shed = [0]
+            errors: list = []
+
+            def client(seed: int):
+                r = np.random.default_rng(seed)
+                end = time.perf_counter() + duration
+                my = []
+                my_shed = 0
+                conn = _hc.HTTPConnection(
+                    "127.0.0.1", router_port, timeout=30
+                )
+                try:
+                    while time.perf_counter() < end:
+                        body = bodies[int(r.integers(0, len(bodies)))]
+                        t0 = time.perf_counter()
+                        try:
+                            conn.request(
+                                "POST", "/score", body=body,
+                                headers={"Content-Type": "text/plain"},
+                            )
+                            resp = conn.getresponse()
+                            resp.read()
+                            if resp.will_close:
+                                conn.close()
+                                conn = _hc.HTTPConnection(
+                                    "127.0.0.1", router_port,
+                                    timeout=30,
+                                )
+                        except (OSError, _hc.HTTPException) as e:
+                            errors.append(f"{type(e).__name__}: {e}")
+                            return
+                        if resp.status == 200:
+                            my.append(time.perf_counter() - t0)
+                        elif resp.status == 429:
+                            # A shed IS the overload discipline
+                            # working: count it, back off briefly
+                            # (real clients honor Retry-After; the
+                            # bench caps it at 50 ms so the window
+                            # still measures sustained overload —
+                            # zero-backoff clients would just burn
+                            # the box on the shed path itself).
+                            my_shed += 1
+                            time.sleep(0.05)
+                        else:
+                            errors.append(f"HTTP {resp.status}")
+                            return
+                finally:
+                    conn.close()
+                    with lat_lock:
+                        lats.extend(my)
+                        shed[0] += my_shed
+
+            threads = [
+                _th.Thread(target=client, args=(200 + i,))
+                for i in range(n_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return lats, shed[0], errors, time.perf_counter() - t0
+
+        # Warm the proxy path (connection pools, both replicas) before
+        # measuring.
+        for _ in range(4):
+            _rq.urlopen(_rq.Request(url, data=bodies[0], method="POST"),
+                        timeout=60).read()
+        n_clients = min(8, max(2, workers))
+        lats, shed, errors, wall = window(n_clients, 4.0, bodies)
+        if not lats:
+            out["error"] = "no request completed: " + "; ".join(
+                errors[:3]
+            )
+            return out
+        arr = np.array(lats) * 1e3
+        out.update({
+            "replicas": len(handle.replicas),
+            "clients": n_clients,
+            "duration_s": round(wall, 2),
+            "requests": len(lats),
+            "serve_router_qps": round(len(lats) / wall, 1),
+            "serve_router_p50_ms": round(
+                float(np.percentile(arr, 50)), 3
+            ),
+            "serve_router_p99_ms": round(
+                float(np.percentile(arr, 99)), 3
+            ),
+            "unloaded_shed": shed,
+        })
+        # The burst's fair baseline: the same max-rung-heavy bodies,
+        # unloaded (a 64-example request costs more than the mixed
+        # shape above even with no queue).
+        h_lats, _, h_errors, _ = window(n_clients, 2.0, burst_bodies)
+        h_arr = np.array(h_lats) * 1e3 if h_lats else np.zeros(1)
+        heavy_unloaded_p99 = float(np.percentile(h_arr, 99))
+        # Burst probe: 4x the offered concurrency for 3 s, max-rung
+        # bodies.  The admission budget must shed (429) rather than
+        # queue, and the ADMITTED tail must stay near the unloaded
+        # tail (serve_burst_p99_x is admitted-p99 over the
+        # same-bodies unloaded p99 — the graceful-degradation ratio;
+        # note everything here shares one box, so core contention
+        # itself inflates burst service time on small hosts).
+        b_lats, b_shed, b_errors, b_wall = window(
+            n_clients * 4, 3.0, burst_bodies
+        )
+        total = len(b_lats) + b_shed
+        b_arr = np.array(b_lats) * 1e3 if b_lats else np.zeros(1)
+        burst_p99 = float(np.percentile(b_arr, 99))
+        out.update({
+            "burst_clients": n_clients * 4,
+            "burst_requests": total,
+            "serve_shed_frac": round(
+                b_shed / total, 4
+            ) if total else 0.0,
+            "serve_burst_p99_ms": round(burst_p99, 3),
+            "burst_unloaded_p99_ms": round(heavy_unloaded_p99, 3),
+            "serve_burst_p99_x": round(
+                burst_p99 / heavy_unloaded_p99, 3
+            ) if heavy_unloaded_p99 > 0 else 0.0,
+            "burst_admitted_qps": round(
+                len(b_lats) / b_wall, 1
+            ) if b_wall > 0 else 0.0,
+        })
+        errors.extend(h_errors)
+        if errors or b_errors:
+            out["client_errors"] = (errors + b_errors)[:5]
+        # Per-replica steady-compile audit: the zero-compile contract
+        # must hold on every replica (scraped from each replica's own
+        # /metrics), and the router must not have evicted anyone.
+        steady = []
+        for rep in handle.replicas:
+            try:
+                text = _rq.urlopen(
+                    f"http://{rep.host}:{rep.port}/metrics", timeout=5
+                ).read().decode()
+                m = re.search(
+                    r"^tffm_serve_steady_compiles (\d+)", text,
+                    re.MULTILINE,
+                )
+                steady.append(int(m.group(1)) if m else -1)
+            except Exception:  # noqa: BLE001 - audit is best-effort
+                steady.append(-1)
+        out["serve_router_steady_compiles"] = max(steady) if steady \
+            else -1
+        router_block = handle.router._build()["serve"]
+        out["router_evictions"] = router_block["evictions"]
+        out["router_retries"] = router_block["retries"]
+        out["completed"] = True
+    except Exception as e:  # noqa: BLE001 - report, never sink the bench
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if handle is not None:
+            handle.close()
+        _sh.rmtree(tmpdir, ignore_errors=True)
     return out
 
 
@@ -1103,6 +1396,7 @@ def main() -> int:
     s_samples, s1_samples, e_samples = [], [], []
     tiered_section = None
     serve_section = None
+    serve_router_section = None
     quant_section = None
     dispatch_overhead_ms, h2d_overlap_frac = 0.0, 0.0
     e2e_epoch0, e2e_cached = 0.0, 0.0
@@ -1385,6 +1679,15 @@ def main() -> int:
             # never skew another section's memory reading again,
             # whatever the order.
             serve_section = _with_rss_delta(_bench_serve, workers)
+            # Scale-out serving section: the 2-replica router fleet
+            # (real subprocess replicas) under load and under a
+            # 4x-offered burst — the shed/eviction discipline's
+            # numbers.  Runs right after the single-replica section so
+            # serve_router_qps / serve_qps is measured on the same box
+            # state.
+            serve_router_section = _with_rss_delta(
+                _bench_serve_router, workers
+            )
             # Tiered-table section: the V=2^28 run a dense device table
             # cannot hold, plus its dense V=2^26 overlap baseline.  Its
             # own trainers/files; isolated from the judged numbers above.
@@ -1529,6 +1832,29 @@ def main() -> int:
                         "serve_qps", "serve_batch_fill",
                         "serve_steady_compiles"):
                 result[key] = serve_section[key]
+    if serve_router_section is not None:
+        result["serve_router"] = serve_router_section
+        if serve_router_section.get("completed"):
+            # Gated axes of the fleet (report.py directions: qps high;
+            # p50/p99, the burst's admitted p99, the shed fraction at
+            # fixed 4x offered load, and bin decode cost all low).
+            for key in ("serve_router_qps", "serve_router_p50_ms",
+                        "serve_router_p99_ms", "serve_shed_frac",
+                        "serve_burst_p99_ms", "serve_burst_p99_x"):
+                result[key] = serve_router_section[key]
+            if (
+                serve_section is not None
+                and serve_section.get("completed")
+                and serve_section.get("serve_qps")
+            ):
+                # The scale-out headline: 2-replica router throughput
+                # over the single-process section's, same box, same
+                # traffic shape.  Meaningful on multi-core hosts; on a
+                # 1-core box both fleets share the core.
+                result["serve_router_scaleout_x"] = round(
+                    serve_router_section["serve_router_qps"]
+                    / serve_section["serve_qps"], 4
+                )
     if quant_section is not None:
         result["quantized_table"] = quant_section
         if quant_section.get("completed"):
@@ -1549,7 +1875,7 @@ def main() -> int:
                 )
     if serve_section is not None and serve_section.get("completed"):
         for key in ("serve_table_mb", "serve_parse_p50_ms",
-                    "serve_quant_error_max_int8"):
+                    "serve_bin_p50_ms", "serve_quant_error_max_int8"):
             if key in serve_section:
                 result[key] = serve_section[key]
     if tier1_audit is not None:

@@ -209,6 +209,46 @@ def build_argparser() -> argparse.ArgumentParser:
              "seconds and hot-swap new params with zero recompiles "
              "(0 = serve the startup checkpoint forever)",
     )
+    p.add_argument(
+        "--replicas", type=int, default=None, dest="serve_replicas",
+        help="serve mode: run N shared-nothing replica serve processes "
+             "behind a power-of-two-choices router on --serve_port "
+             "(0/1 = the classic single-process server)",
+    )
+    p.add_argument(
+        "--serve_shed_deadline_ms", type=float, default=None,
+        help="router admission budget: shed with a fast 429 when the "
+             "projected queue delay exceeds this many ms (0 = admit "
+             "everything)",
+    )
+    p.add_argument(
+        "--serve_canary", action="store_true", default=None,
+        help="canary checkpoint promotion: the router reloads ONE "
+             "replica on a new manifest, shadow-compares its score "
+             "distribution against a baseline replica (tools/report.py "
+             "--compare), and only then promotes the fleet (requires "
+             "--replicas >= 2)",
+    )
+    p.add_argument(
+        "--no_serve_canary", action="store_true",
+        help="force canary promotion OFF regardless of the cfg file "
+             "(the fleet launcher passes this to every replica so an "
+             "INI-configured canary fleet doesn't trip each child's "
+             "serve_canary-requires-a-fleet validation)",
+    )
+    p.add_argument(
+        "--serve_transport", choices=["text", "bin", "both"],
+        default=None,
+        help="request transports the scoring endpoints accept: libsvm "
+             "text (POST /score), the binary frame (POST /score_bin), "
+             "or both",
+    )
+    p.add_argument(
+        "--metrics_file", default=None, metavar="PATH",
+        help="JSONL metrics stream path (overrides the cfg; a "
+             "multi-replica fleet suffixes each replica's stream "
+             ".replicaN)",
+    )
     # Legacy reference flags (mapped, SURVEY.md §3.2).
     p.add_argument("--ps_hosts", default=None, help="legacy; ps tasks exit")
     p.add_argument("--worker_hosts", default=None,
@@ -261,13 +301,17 @@ def main(argv=None) -> int:
                     "status_port", "status_host", "alert_rules",
                     "trace_rotate_events", "serve_port", "serve_host",
                     "serve_batch_sizes", "max_batch_wait_ms",
-                    "serve_poll_secs")
+                    "serve_poll_secs", "serve_replicas",
+                    "serve_shed_deadline_ms", "serve_canary",
+                    "serve_transport", "metrics_file")
         if getattr(args, key) is not None
     }
     if args.no_telemetry:
         overrides["telemetry"] = False
     if args.no_resource_metrics:
         overrides["resource_metrics"] = False
+    if args.no_serve_canary:
+        overrides["serve_canary"] = False
     cfg = load_config(args.cfg, overrides or None)
     _setup_logging(cfg.log_file or None)
     dist = _resolve_dist(args)
@@ -277,6 +321,10 @@ def main(argv=None) -> int:
         dist_lib.initialize(*dist)
 
     if args.mode == "serve":
+        if cfg.serve_replicas >= 2:
+            from fast_tffm_tpu.serve.router import serve_fleet
+
+            return serve_fleet(cfg, args.cfg, overrides)
         from fast_tffm_tpu.serve.server import serve_forever
 
         return serve_forever(cfg)

@@ -148,6 +148,31 @@ class FmConfig:
     # in between dispatches (zero recompiles, no dropped requests).
     # 0 = serve the startup checkpoint forever.
     serve_poll_secs: float = 2.0
+    # Scale-out serving (serve/router.py): run this many shared-nothing
+    # replica serve processes (each the full scorer/batcher/server
+    # stack on its own port) behind a power-of-two-choices router on
+    # serve_port.  0 or 1 = the classic single-process server, no
+    # router.  See SERVING.md "Scale-out".
+    serve_replicas: int = 0
+    # Router admission control: a request is shed with a fast 429 (+
+    # Retry-After) when the fleet's projected queue delay — in-flight
+    # requests over the measured completion rate — exceeds this budget,
+    # so admitted-request p99 stays bounded instead of collapsing under
+    # a traffic spike.  0 = admit everything (latency grows unboundedly
+    # under overload).
+    serve_shed_deadline_ms: float = 50.0
+    # Rolling manifest promotion: instead of every replica self-swapping
+    # on the manifest poll, the ROUTER canaries one replica on the new
+    # checkpoint, shadow-scores a recent traffic sample against a
+    # baseline replica, compares the score distributions via
+    # `tools/report.py --compare`, and only then promotes the fleet
+    # (or rolls the canary back).  Requires serve_replicas >= 2.
+    serve_canary: bool = False
+    # Which request transports the scoring endpoints accept: "text"
+    # (POST /score, libsvm lines), "bin" (POST /score_bin,
+    # length-prefixed little-endian id/value/field arrays — skips text
+    # parsing on the hot path entirely), or "both" (default).
+    serve_transport: str = "both"
 
     # --- observability (SURVEY.md §5: tracing/metrics rebuild) ---
     # Directory for a jax.profiler trace of steps
@@ -442,6 +467,37 @@ class FmConfig:
             raise ValueError(
                 f"serve_poll_secs must be >= 0, got {self.serve_poll_secs}"
             )
+        if self.serve_replicas < 0:
+            raise ValueError(
+                f"serve_replicas must be >= 0, got {self.serve_replicas}"
+            )
+        if self.serve_shed_deadline_ms < 0:
+            raise ValueError(
+                "serve_shed_deadline_ms must be >= 0, got "
+                f"{self.serve_shed_deadline_ms}"
+            )
+        if self.serve_transport not in ("text", "bin", "both"):
+            raise ValueError(
+                f"unknown serve_transport {self.serve_transport!r}"
+            )
+        if self.serve_canary and self.serve_replicas < 2:
+            # The silently-inert-knob discipline (same as cold_dtype /
+            # alert_rules): canary promotion shadow-compares one
+            # replica against another, so without a >= 2-replica fleet
+            # the knob could never do anything.
+            raise ValueError(
+                "serve_canary requires serve_replicas >= 2 (promotion "
+                "shadow-scores the canary against a baseline replica)"
+            )
+        if self.serve_canary and self.serve_poll_secs <= 0:
+            # Same hazard one knob over: the router's canary watcher
+            # polls the manifest at serve_poll_secs, so 0 means no
+            # promotion could ever start.
+            raise ValueError(
+                "serve_canary requires serve_poll_secs > 0 (the "
+                "router's promotion watcher polls the manifest at "
+                "that cadence)"
+            )
         self.serve_ladder  # parse/validate serve_batch_sizes at startup
         if self.cache_max_bytes <= 0:
             raise ValueError(
@@ -575,6 +631,10 @@ _KEYMAP = {
     "serve_batch_sizes": ("serve_batch_sizes", str),
     "max_batch_wait_ms": ("max_batch_wait_ms", float),
     "serve_poll_secs": ("serve_poll_secs", float),
+    "serve_replicas": ("serve_replicas", int),
+    "serve_shed_deadline_ms": ("serve_shed_deadline_ms", float),
+    "serve_canary": ("serve_canary", _parse_bool),
+    "serve_transport": ("serve_transport", str),
     "profile_dir": ("profile_dir", str),
     "profile_start_step": ("profile_start_step", int),
     "profile_steps": ("profile_steps", int),

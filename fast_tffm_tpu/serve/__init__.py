@@ -12,13 +12,30 @@ endpoint (``POST /score`` + the same ``/metrics``/``/status`` surface as
 save-path manifest.  See SERVING.md for the dataflow.
 """
 
-from fast_tffm_tpu.serve.batcher import ServeBatcher
-from fast_tffm_tpu.serve.scorer import (
-    FixedShapeScorer, OverlayScorer, load_model, make_scorer,
-)
-from fast_tffm_tpu.serve.server import ServeHandle, serve, serve_forever
+# Lazy re-exports (PEP 562): the convenience names below pull in jax
+# (scorer -> jax, server -> scorer), but this package also hosts the
+# ROUTER process's jax-free modules (serve.wire, serve.router) — an
+# eager import here would defeat that, so the heavy modules load only
+# when one of their names is actually touched.
+_EXPORTS = {
+    "ServeBatcher": "fast_tffm_tpu.serve.batcher",
+    "FixedShapeScorer": "fast_tffm_tpu.serve.scorer",
+    "OverlayScorer": "fast_tffm_tpu.serve.scorer",
+    "load_model": "fast_tffm_tpu.serve.scorer",
+    "make_scorer": "fast_tffm_tpu.serve.scorer",
+    "ServeHandle": "fast_tffm_tpu.serve.server",
+    "serve": "fast_tffm_tpu.serve.server",
+    "serve_forever": "fast_tffm_tpu.serve.server",
+}
 
-__all__ = [
-    "FixedShapeScorer", "OverlayScorer", "ServeBatcher", "ServeHandle",
-    "load_model", "make_scorer", "serve", "serve_forever",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

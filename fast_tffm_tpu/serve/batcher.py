@@ -76,6 +76,10 @@ class ServeBatcher:
         self._c_batches = tel.counter("serve.batches")
         self._t_latency = tel.timer("serve.latency")
         self._g_fill = tel.gauge("serve.batch_fill")
+        # Live in-flight count (accepted, scores not yet delivered):
+        # the replica-side load signal the router's P2C dispatch and
+        # the overload discipline reason about.
+        self._g_inflight = tel.gauge("serve.inflight")
         self._q = _ClosableQueue(
             queue_size, hist=tel.depth_hist("serve.queue_depth")
         )
@@ -114,12 +118,20 @@ class ServeBatcher:
             if self._closed:
                 raise RuntimeError("ServeBatcher is closed")
             self._outstanding.add(req)
+            self._g_inflight.set(len(self._outstanding))
         if not self._q.put(req):
             with self._out_lock:
                 self._outstanding.discard(req)
+                self._g_inflight.set(len(self._outstanding))
             raise RuntimeError("ServeBatcher is closed")
         self._c_requests.add()
         return req
+
+    @property
+    def inflight(self) -> int:
+        """Requests accepted but not yet answered (live load)."""
+        with self._out_lock:
+            return len(self._outstanding)
 
     def result(self, req: ScoreRequest,
                timeout: float = 30.0) -> np.ndarray:
@@ -232,6 +244,7 @@ class ServeBatcher:
                 self._t_latency.observe(now - g.t0)
                 with self._out_lock:
                     self._outstanding.discard(g)
+                    self._g_inflight.set(len(self._outstanding))
                 g.event.set()
         except BaseException as e:  # noqa: BLE001 - fail the CLIENTS
             log.warning("serve dispatch failed: %s", e)
@@ -239,12 +252,14 @@ class ServeBatcher:
                 g.error = e
                 with self._out_lock:
                     self._outstanding.discard(g)
+                    self._g_inflight.set(len(self._outstanding))
                 g.event.set()
 
     def _fail_outstanding(self, exc: BaseException) -> None:
         with self._out_lock:
             stale = list(self._outstanding)
             self._outstanding.clear()
+            self._g_inflight.set(0)
         for req in stale:
             req.error = exc
             req.event.set()

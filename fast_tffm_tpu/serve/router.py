@@ -1,0 +1,1136 @@
+"""Scale-out serving: N shared-nothing replicas behind a small router.
+
+One serve process tops out at one box's worth of a single Python
+runtime; ROADMAP direction 3 wants throughput that scales with
+processes and p99 that degrades gracefully under spike traffic.  This
+module is that layer, all stdlib + numpy (the router process never
+imports jax — replicas own the devices):
+
+- :class:`ReplicaManager` — spawns ``serve_replicas`` replica serve
+  subprocesses (each the existing scorer/batcher/server stack on its
+  own OS-assigned port, announced on stdout) and owns their teardown
+  (terminate/wait, kill after a grace period).
+- :class:`ServeRouter` — an HTTP front door on ``serve_port`` doing
+  **power-of-two-choices** dispatch: pick two healthy replicas at
+  random, send to the one with fewer router-tracked in-flight requests.
+  Health comes from the replicas' existing ``/healthz`` surface (plus
+  process liveness and proxy failures): an unhealthy replica is
+  EVICTED from routing and readmitted when it answers again; a request
+  caught on a dying replica retries transparently on another.
+- **Overload discipline** — admission control with a per-request
+  deadline budget (``serve_shed_deadline_ms``): projected queue delay
+  is in-flight requests over the measured completion rate (Little's
+  law), and a request that could not be answered inside the budget is
+  shed with a fast ``429`` + ``Retry-After`` instead of queuing — p99
+  of ADMITTED requests stays bounded instead of collapsing.
+  ``serve.shed`` / ``serve.inflight`` / per-replica routed counters
+  ride the serve block and ``/metrics``.
+- **Canary promotion** (``serve_canary``) — replicas are launched with
+  their manifest watcher OFF; the router watches
+  ``serve_manifest.json`` itself, reloads ONE replica on a new
+  checkpoint (the replica keeps the replaced params restorable),
+  shadow-scores a recent traffic sample against a baseline replica,
+  compares the two score distributions via ``tools/report.py
+  --compare``, and only then rolls the reload across the fleet — or
+  rolls the canary back.  Every swap stays the scorer's
+  reference-swap, so no request is ever served a torn table.
+
+Transport is pass-through: the router proxies ``POST /score`` (libsvm
+text) and ``POST /score_bin`` (the binary frame, serve/wire.py)
+verbatim, reusing kept-alive connections to each replica.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import logging
+import os
+import random
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.obs.status import (
+    ObsHTTPServer, QuietHandler, render_prometheus,
+)
+from fast_tffm_tpu.serve import wire
+from fast_tffm_tpu.train import manifest
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "FleetHandle", "Replica", "ReplicaManager", "ServeRouter",
+    "serve_fleet", "start_fleet",
+]
+
+# The replica CLI announces its bound port with this exact line
+# (server.serve_forever's print) — the manager parses it instead of
+# pre-allocating ports, so there is no bind race.
+_PORT_RE = re.compile(r"serving on [^\s:]+:(\d+)")
+
+# Consecutive /healthz failures before the health loop evicts (proxy
+# failures evict immediately — they already cost a request a retry).
+_FAIL_EVICT = 2
+
+# Largest request body the canary shadow-scoring ring retains (bounds
+# the ring at maxlen * this many bytes).
+_SAMPLE_BODY_MAX = 256 << 10
+
+
+class Replica:
+    """Router-side state for one backend replica.
+
+    ``proc`` is the managed subprocess (None for an externally-run
+    backend, e.g. tests pointing the router at fake replicas).
+    ``inflight``/``routed``/``healthy``/``fails``/``quarantined`` are
+    guarded by the router's lock.  A QUARANTINED replica is one whose
+    params can no longer be trusted (a rejected canary whose rollback
+    failed): alive is not enough to readmit it — the health loop skips
+    it until a later successful promotion reloads it onto a vetted
+    checkpoint.
+    """
+
+    __slots__ = ("index", "host", "port", "proc", "inflight", "routed",
+                 "healthy", "fails", "quarantined")
+
+    def __init__(self, index: int, host: str, port: int, proc=None):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.proc = proc
+        self.inflight = 0
+        self.routed = 0
+        self.healthy = True
+        self.fails = 0
+        self.quarantined = False
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class _ReplicaProc:
+    """One spawned replica subprocess: stdout port announcement +
+    ordered teardown.  The stdout pipe is drained for the process's
+    lifetime so a chatty child can never block on a full pipe."""
+
+    def __init__(self, index: int, cmd: list, env: dict):
+        self.index = index
+        self.port: Optional[int] = None
+        self.ready = threading.Event()
+        self.proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+        )
+        self._thread = threading.Thread(
+            target=self._drain, name=f"tffm-replica-stdout-{index}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _drain(self) -> None:
+        try:
+            for raw in self.proc.stdout:
+                if self.port is None:
+                    m = _PORT_RE.search(raw.decode("utf-8", "replace"))
+                    if m:
+                        self.port = int(m.group(1))
+                        self.ready.set()
+        finally:
+            self.ready.set()  # EOF with no port = startup failure
+
+    def close(self, grace_s: float = 10.0) -> None:
+        """Terminate and reap; SIGKILL after the grace period.  A
+        replica that already died (or was killed externally) just gets
+        reaped."""
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(grace_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        else:
+            self.proc.wait()
+        self._thread.join()
+        if self.proc.stdout is not None:
+            self.proc.stdout.close()
+
+
+# CLI overrides the fleet launcher consumes itself (or forces per
+# replica) rather than passing through.
+_NO_PASSTHROUGH = {
+    "serve_replicas", "serve_port", "serve_host", "serve_canary",
+    "serve_poll_secs", "metrics_file",
+}
+
+
+def _passthrough_flags(overrides: Optional[dict]) -> list:
+    """Re-render the router invocation's CLI overrides as replica
+    flags, so ``serve --replicas 2 --serve_table_dtype int8`` means the
+    same thing on every replica as it would single-process."""
+    args: list = []
+    for key, val in sorted((overrides or {}).items()):
+        if key in _NO_PASSTHROUGH or val is None:
+            continue
+        if key == "telemetry":
+            if val is False:
+                args.append("--no_telemetry")
+            continue
+        if key == "resource_metrics":
+            if val is False:
+                args.append("--no_resource_metrics")
+            continue
+        if key == "trace_file":
+            args += ["--trace", str(val)]
+            continue
+        flag = "--" + key
+        if val is True:
+            args.append(flag)
+        elif val is not False:
+            args += [flag, str(val)]
+    return args
+
+
+def _replica_command(cfg: FmConfig, cfg_path: str, index: int,
+                     overrides: Optional[dict]) -> list:
+    cmd = [
+        sys.executable, "-m", "fast_tffm_tpu.cli", "serve", cfg_path,
+        # --replicas 0 pins the child single-process even when the cfg
+        # file itself sets serve_replicas (a fleet must never recurse),
+        # and --no_serve_canary force-clears an INI serve_canary so the
+        # child doesn't trip its own canary-requires-a-fleet
+        # validation.
+        "--replicas", "0", "--no_serve_canary",
+        "--serve_port", "0", "--serve_host", "127.0.0.1",
+        # Canary mode turns the replicas' own manifest watchers OFF —
+        # the router drives every swap; otherwise replicas self-swap on
+        # their usual poll cadence.
+        "--serve_poll_secs",
+        "0" if cfg.serve_canary else str(cfg.serve_poll_secs),
+    ]
+    if cfg.metrics_file:
+        # One JSONL stream per process: N replicas appending to the
+        # router's configured path would interleave into garbage.
+        cmd += ["--metrics_file", f"{cfg.metrics_file}.replica{index}"]
+    return cmd + _passthrough_flags(overrides)
+
+
+class ReplicaManager:
+    """Spawn and own ``cfg.serve_replicas`` shared-nothing replica
+    serve subprocesses.
+
+    Each replica is the full existing stack (``run_tffm.py serve`` on
+    an OS-assigned port); startup blocks until every replica announces
+    its port (which serve_forever prints only after the ladder is
+    warmed, so a ready replica is a WARM replica).  ``close()`` tears
+    every process down terminate->wait->kill.
+    """
+
+    def __init__(self, cfg: FmConfig, cfg_path: str,
+                 overrides: Optional[dict] = None,
+                 startup_timeout_s: float = 300.0):
+        if cfg.serve_replicas < 2:
+            raise ValueError(
+                "ReplicaManager needs serve_replicas >= 2 (a single "
+                "process does not want a router)"
+            )
+        env = os.environ.copy()
+        # Children launch via `-m fast_tffm_tpu.cli`; the parent may
+        # have found the package through script-dir sys.path injection,
+        # which the environment does not inherit.
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        self._procs: list = []
+        self.replicas: list = []
+        try:
+            for i in range(cfg.serve_replicas):
+                cmd = _replica_command(cfg, cfg_path, i, overrides)
+                self._procs.append(_ReplicaProc(i, cmd, env))
+            deadline = time.time() + startup_timeout_s
+            for rp in self._procs:
+                rp.ready.wait(max(0.0, deadline - time.time()))
+                if rp.port is None:
+                    raise RuntimeError(
+                        f"replica {rp.index} did not announce a "
+                        f"serving port within {startup_timeout_s:.0f}s "
+                        f"(exit code {rp.proc.poll()})"
+                    )
+                self.replicas.append(
+                    Replica(rp.index, "127.0.0.1", rp.port, proc=rp.proc)
+                )
+            log.info(
+                "replica fleet up: %s",
+                ", ".join(f"#{r.index}@{r.address}" for r in
+                          self.replicas),
+            )
+        except BaseException:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        for rp in self._procs:
+            try:
+                rp.close()
+            except Exception as e:  # noqa: BLE001 - teardown best-effort
+                log.warning("replica %d teardown failed: %s",
+                            rp.index, e)
+        self._procs = []
+
+
+class _ProxyError(Exception):
+    """A connection-level failure talking to a replica (the replica is
+    presumed dying; the request is retried elsewhere)."""
+
+
+class ServeRouter:
+    """The HTTP front door: P2C dispatch + overload discipline + the
+    canary promotion protocol, over any list of :class:`Replica`."""
+
+    def __init__(self, port: int, replicas, cfg: FmConfig,
+                 telemetry=None, writer=None, host: str = "127.0.0.1",
+                 health_secs: float = 0.5,
+                 manifest_seen: Optional[dict] = None,
+                 proxy_timeout_s: float = 30.0):
+        self.cfg = cfg
+        tel = telemetry if telemetry is not None else obs.NULL
+        self._tel = tel
+        self._c_requests = tel.counter("serve.router_requests")
+        self._c_shed = tel.counter("serve.shed")
+        self._c_evictions = tel.counter("serve.evictions")
+        self._c_readmissions = tel.counter("serve.readmissions")
+        self._c_retries = tel.counter("serve.retries")
+        self._c_promotions = tel.counter("serve.canary_promotions")
+        self._c_rollbacks = tel.counter("serve.canary_rollbacks")
+        self._g_inflight = tel.gauge("serve.inflight")
+        self._t_proxy = tel.timer("serve.proxy")
+        self._writer = writer
+        self._replicas = list(replicas)
+        self._lock = threading.Lock()
+        self._rng = random.Random(0xF00D)
+        self._deadline_s = cfg.serve_shed_deadline_ms / 1e3
+        self._proxy_timeout_s = proxy_timeout_s
+        # Completion timestamps inside a sliding window: the measured
+        # service rate the admission budget divides by (Little's law).
+        self._rate_window_s = 1.0
+        self._completions: collections.deque = collections.deque()
+        # Idle kept-alive connections per replica index.
+        self._conns: dict = {r.index: [] for r in self._replicas}
+        # Recent request bodies, the canary shadow-scoring sample.
+        self._sample: collections.deque = collections.deque(maxlen=32)
+        self._health_secs = max(0.05, float(health_secs))
+        self.step = int((manifest_seen or {}).get("step", 0))
+        self._seen = manifest_seen
+        self._t0 = time.time()
+        self._stop = threading.Event()
+        router = self
+
+        class Handler(QuietHandler):
+            def do_POST(self) -> None:  # noqa: N802 - http.server API
+                router._c_requests.add()
+                path = self.path.partition("?")[0]
+                if path not in ("/score", "/score_bin"):
+                    self._send(404, b"not found\n", "text/plain")
+                    return
+                want = "text" if path == "/score" else "bin"
+                if cfg.serve_transport not in (want, "both"):
+                    self._send(
+                        404, f"transport {want!r} disabled "
+                             f"(serve_transport="
+                             f"{cfg.serve_transport})\n".encode(),
+                        "text/plain",
+                    )
+                    return
+                body = self._read_body(wire.MAX_BODY_BYTES)
+                if body is None:
+                    return  # error response already sent
+                ctype = self.headers.get(
+                    "Content-Type",
+                    "text/plain" if want == "text"
+                    else "application/octet-stream",
+                )
+                status, data, rctype, headers = router._handle(
+                    path, body, ctype
+                )
+                # The body was fully consumed above, so even an error
+                # status is keep-alive-safe — and a shedding router
+                # MUST keep connections open (closing them turns every
+                # 429 into a client reconnect under peak load).
+                self._send(
+                    status, data, rctype, headers=headers,
+                    keep_alive=True,
+                )
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                path = self.path.partition("?")[0]
+                if path == "/metrics":
+                    # /metrics grows per-replica labeled series the
+                    # flat record rendering cannot express, so the
+                    # router renders it itself.
+                    self._send(
+                        200, router._render_metrics().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    return
+                if self._get_observability(path, router._build):
+                    return
+                self._send(404, b"not found\n", "text/plain")
+
+        # Every attribute a handler can touch must exist BEFORE the
+        # HTTP thread starts: on a fixed, well-known port a retrying
+        # client can connect the instant the socket binds.
+        self._closed = False
+        self._canary_thread = (
+            threading.Thread(
+                target=self._canary_loop, name="tffm-router-canary",
+                daemon=True,
+            )
+            if cfg.serve_canary else None
+        )
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="tffm-router-health",
+            daemon=True,
+        )
+        self._httpd = ObsHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tffm-router-http",
+            daemon=True,
+        )
+        self._thread.start()
+        self._health_thread.start()
+        if self._canary_thread is not None:
+            self._canary_thread.start()
+
+    # -- dispatch --------------------------------------------------------
+
+    def _completion_rate(self) -> float:
+        """Completions/sec over the sliding window (caller holds the
+        lock)."""
+        now = time.perf_counter()
+        dq = self._completions
+        while dq and now - dq[0] > self._rate_window_s:
+            dq.popleft()
+        return len(dq) / self._rate_window_s
+
+    def _admit(self):
+        """(replica, None) when admitted — in-flight already counted —
+        or (None, "shed" | "none")."""
+        with self._lock:
+            healthy = [r for r in self._replicas if r.healthy]
+            if not healthy:
+                return None, "none"
+            total = sum(r.inflight for r in healthy)
+            if self._deadline_s > 0:
+                # Deadline-budget admission: with I requests in flight
+                # completing at X/sec, a new arrival waits ~I/X before
+                # its turn (Little's law).  The 2-per-replica floor
+                # always admits at trickle load, where the rate window
+                # has nothing in it.
+                floor = 2 * len(healthy)
+                if total >= floor:
+                    rate = self._completion_rate()
+                    if rate > 0 and (total + 1) / rate > self._deadline_s:
+                        return None, "shed"
+            if len(healthy) >= 2:
+                a, b = self._rng.sample(healthy, 2)
+                rep = a if a.inflight <= b.inflight else b
+            else:
+                rep = healthy[0]
+            rep.inflight += 1
+            rep.routed += 1
+            self._g_inflight.set(total + 1)
+            return rep, None
+
+    def _pick_retry(self, exclude):
+        """Re-pick after a proxy failure (least-loaded healthy replica
+        other than the failed one); counts the in-flight slot."""
+        with self._lock:
+            healthy = [
+                r for r in self._replicas
+                if r.healthy and r is not exclude
+            ]
+            if not healthy:
+                return None
+            rep = min(healthy, key=lambda r: r.inflight)
+            rep.inflight += 1
+            rep.routed += 1
+            return rep
+
+    def _dec(self, rep: Replica) -> None:
+        with self._lock:
+            rep.inflight = max(0, rep.inflight - 1)
+            self._g_inflight.set(
+                sum(r.inflight for r in self._replicas)
+            )
+
+    def _handle(self, path: str, body: bytes, ctype: str):
+        """Route one scoring request; returns (status, body, ctype,
+        headers-or-None) for the front handler to send."""
+        rep, why = self._admit()
+        if rep is None:
+            if why == "shed":
+                self._c_shed.add()
+                return (
+                    429,
+                    b"overloaded: projected queue delay exceeds "
+                    b"serve_shed_deadline_ms; retry\n",
+                    "text/plain", {"Retry-After": "1"},
+                )
+            return 503, b"no healthy replica\n", "text/plain", None
+        t0 = time.perf_counter()
+        while True:
+            try:
+                status, data, rctype = self._forward(
+                    rep, path, body, ctype
+                )
+                break
+            except _ProxyError as e:
+                # The replica died under the request: evict it and
+                # retry the (idempotent) scoring request elsewhere —
+                # a SIGKILLed replica costs its in-flight requests one
+                # retry, not an error.
+                self._dec(rep)
+                self._evict(rep, f"proxy failure: {e}")
+                self._c_retries.add()
+                rep = self._pick_retry(exclude=rep)
+                if rep is None:
+                    return (503, b"no healthy replica\n", "text/plain",
+                            None)
+        self._dec(rep)
+        now = time.perf_counter()
+        self._t_proxy.observe(now - t0)
+        with self._lock:
+            self._completions.append(now)
+        if (
+            self._canary_thread is not None and status == 200
+            and len(body) <= _SAMPLE_BODY_MAX
+        ):
+            # Shadow-scoring sample; the size guard bounds the ring at
+            # maxlen * _SAMPLE_BODY_MAX bytes (bodies can legally be
+            # up to the 64 MiB cap).
+            self._sample.append((path, body))
+        return status, data, rctype, None
+
+    # -- replica connections ----------------------------------------------
+
+    def _conn_acquire(self, rep: Replica):
+        """(connection, reused) — a pooled kept-alive connection when
+        one is idle, else a fresh one."""
+        with self._lock:
+            pool = self._conns.get(rep.index) or []
+            if pool:
+                return pool.pop(), True
+        return http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self._proxy_timeout_s
+        ), False
+
+    def _conn_release(self, rep: Replica, conn) -> None:
+        with self._lock:
+            if rep.healthy:
+                self._conns.setdefault(rep.index, []).append(conn)
+                return
+        conn.close()
+
+    def _forward(self, rep: Replica, path: str, body: bytes,
+                 ctype: str):
+        """One proxied POST.  A failure on a REUSED connection retries
+        once on a fresh one (an idle kept-alive socket the replica
+        timed out is stale, not a dead replica); a fresh-connection
+        failure raises _ProxyError."""
+        for attempt in (0, 1):
+            conn, reused = self._conn_acquire(rep)
+            if attempt and reused:
+                # Second pass must be a real liveness probe.
+                conn.close()
+                conn, reused = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=self._proxy_timeout_s
+                ), False
+            try:
+                conn.request(
+                    "POST", path, body=body,
+                    headers={"Content-Type": ctype},
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if reused:
+                    continue  # stale pooled socket; try fresh
+                raise _ProxyError(f"{type(e).__name__}: {e}") from e
+            rctype = resp.getheader("Content-Type") or "text/plain"
+            if resp.will_close or resp.status >= 400:
+                conn.close()
+            else:
+                self._conn_release(rep, conn)
+            return resp.status, data, rctype
+        raise _ProxyError("unreachable")  # pragma: no cover
+
+    # -- health ------------------------------------------------------------
+
+    def _evict(self, rep: Replica, reason: str,
+               quarantine: bool = False) -> None:
+        with self._lock:
+            if quarantine:
+                rep.quarantined = True
+            if not rep.healthy:
+                return
+            rep.healthy = False
+            rep.fails = 0
+            stale = self._conns.get(rep.index) or []
+            self._conns[rep.index] = []
+        for conn in stale:
+            conn.close()
+        self._c_evictions.add()
+        log.warning(
+            "replica %d (%s) EVICTED from routing: %s",
+            rep.index, rep.address, reason,
+        )
+
+    def _readmit(self, rep: Replica) -> None:
+        with self._lock:
+            # A quarantined replica is ALIVE but serving unvetted
+            # params (rejected canary, failed rollback): answering
+            # /healthz must not put it back in rotation — only a later
+            # successful promotion clears the quarantine.
+            if rep.healthy or rep.quarantined:
+                return
+            rep.healthy = True
+            rep.fails = 0
+        self._c_readmissions.add()
+        log.info("replica %d (%s) readmitted to routing",
+                 rep.index, rep.address)
+
+    def _probe_health(self, rep: Replica) -> bool:
+        try:
+            with urllib.request.urlopen(
+                f"http://{rep.address}/healthz", timeout=1.0
+            ) as resp:
+                return resp.status == 200
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self._health_secs):
+            for rep in self._replicas:
+                if self._stop.is_set():
+                    return
+                if rep.proc is not None and rep.proc.poll() is not None:
+                    self._evict(
+                        rep, f"process exited {rep.proc.poll()}"
+                    )
+                    continue
+                if self._probe_health(rep):
+                    with self._lock:
+                        rep.fails = 0
+                    self._readmit(rep)
+                else:
+                    with self._lock:
+                        rep.fails += 1
+                        dead = rep.healthy and rep.fails >= _FAIL_EVICT
+                    if dead:
+                        self._evict(
+                            rep,
+                            f"{rep.fails} consecutive /healthz "
+                            "failures",
+                        )
+
+    # -- canary promotion ---------------------------------------------------
+
+    def _admin(self, rep: Replica, route: str) -> dict:
+        """POST an admin route on a replica; returns the JSON doc.
+        Raises _ProxyError on connection failure and ValueError on a
+        4xx/5xx (the replica refused — e.g. an unservable checkpoint)."""
+        status, data, _ = self._forward(
+            rep, route, b"", "application/octet-stream"
+        )
+        if status != 200:
+            raise ValueError(
+                f"replica {rep.index} {route} answered {status}: "
+                f"{data[:200].decode(errors='replace')}"
+            )
+        return json.loads(data)
+
+    def _canary_loop(self) -> None:
+        poll = max(0.05, self.cfg.serve_poll_secs)
+        while not self._stop.wait(poll):
+            try:
+                self._canary_check()
+            except Exception as e:  # noqa: BLE001 - retry next poll
+                log.warning(
+                    "canary watcher: promotion attempt failed (%s); "
+                    "will retry next poll", e,
+                )
+
+    def _canary_check(self) -> None:
+        man = manifest.read_manifest(self.cfg.model_file)
+        if man is None or man == self._seen:
+            return
+        with self._lock:
+            healthy = [r for r in self._replicas if r.healthy]
+        if len(healthy) < 2:
+            # Promotion needs a canary AND a baseline; retry the next
+            # poll (the manifest stays un-baselined, so an evicted
+            # replica coming back resumes promotion).
+            log.warning(
+                "canary: new checkpoint published but only %d healthy "
+                "replica(s); deferring promotion", len(healthy),
+            )
+            return
+        canary, baseline = healthy[0], healthy[1]
+        try:
+            # keep_prev=1 opens the replica's rollback window (and
+            # anchors it across a retried reload); the fleet-roll and
+            # quarantine-recovery reloads below stay plain — they are
+            # promoted immediately, so retaining a standby table would
+            # only pin memory.
+            step = int(self._admin(
+                canary, "/reload?keep_prev=1"
+            ).get("step", 0))
+        except ValueError as e:
+            # The replica REFUSED the checkpoint (dtype/shape/format
+            # contradiction): permanent for this manifest — baseline
+            # it like the single-process watcher does instead of
+            # re-reading a multi-GB table every poll.
+            log.warning(
+                "canary reload refused (%s); keeping the current "
+                "fleet, will pick up the next save", e,
+            )
+            self._seen = man
+            return
+        ok, detail = self._shadow_compare(canary, baseline, step)
+        if ok:
+            try:
+                self._admin(canary, "/promote")
+            except ValueError as e:  # pragma: no cover - defensive
+                log.warning("canary promote failed: %s", e)
+            promoted = 1
+            for rep in healthy[1:]:
+                try:
+                    self._admin(rep, "/reload")
+                    self._admin(rep, "/promote")
+                    promoted += 1
+                except (ValueError, _ProxyError) as e:
+                    log.warning(
+                        "rolling promotion: replica %d failed to "
+                        "reload (%s) — it keeps serving the OLD "
+                        "params until the next manifest", rep.index, e,
+                    )
+            self._c_promotions.add()
+            self.step = step
+            log.info(
+                "canary promotion to step %d complete (%d/%d "
+                "replicas; %s)", step, promoted, len(healthy), detail,
+            )
+            # A quarantined replica (rejected canary whose rollback
+            # failed) can rejoin ONLY by landing on a vetted
+            # checkpoint: reload it onto the step the fleet just
+            # promoted, then clear the quarantine so the health loop
+            # may readmit it.
+            with self._lock:
+                quarantined = [
+                    r for r in self._replicas if r.quarantined
+                ]
+            for rep in quarantined:
+                try:
+                    self._admin(rep, "/reload")
+                    self._admin(rep, "/promote")
+                    with self._lock:
+                        rep.quarantined = False
+                    log.info(
+                        "quarantined replica %d reloaded onto the "
+                        "promoted step %d; eligible for readmission",
+                        rep.index, step,
+                    )
+                except (ValueError, _ProxyError) as e:
+                    log.warning(
+                        "quarantined replica %d could not reload the "
+                        "promoted checkpoint (%s); it stays out of "
+                        "routing", rep.index, e,
+                    )
+        else:
+            try:
+                self._admin(canary, "/rollback")
+            except (ValueError, _ProxyError) as e:
+                log.warning(
+                    "canary ROLLBACK FAILED on replica %d (%s) — "
+                    "QUARANTINING it rather than serving an unvetted "
+                    "table (a later successful promotion reloads and "
+                    "readmits it)", canary.index, e,
+                )
+                self._evict(
+                    canary,
+                    "rollback failed after a rejected canary",
+                    quarantine=True,
+                )
+            self._c_rollbacks.add()
+            log.warning(
+                "canary REJECTED at step %d: %s — rolled back; this "
+                "manifest is baselined (republish to retry)",
+                step, detail,
+            )
+        self._seen = man
+
+    def _shadow_score(self, rep: Replica, path: str, body: bytes):
+        """Replay one sampled request directly against a replica;
+        returns its scores (list of float) or None on failure."""
+        try:
+            status, data, _ = self._forward(
+                rep, path,
+                body,
+                "text/plain" if path == "/score"
+                else "application/octet-stream",
+            )
+        except _ProxyError:
+            return None
+        if status != 200:
+            return None
+        try:
+            if path == "/score":
+                return [float(tok) for tok in data.split()]
+            return [float(s) for s in wire.decode_bin_response(data)]
+        except ValueError:
+            return None
+
+    def _gate_scale(self, scores) -> np.ndarray:
+        """Scores on a ratio-stable scale for the drift gate.
+
+        Logistic serving already answers sigmoid probabilities in
+        (0, 1), where a ratio IS relative drift.  mse serving answers
+        RAW scores, which routinely sit near (or straddle) zero — a
+        raw-ratio gate there turns negligible absolute drift into
+        huge ratios (or inf, or sign-flipped ratios), spuriously
+        rejecting canaries.  Squashing raw scores through the same
+        sigmoid gives a bounded positive scale that is monotone in
+        the score, so real drift still moves every quantile.
+        """
+        arr = np.asarray(scores, np.float64)
+        if self.cfg.loss_type != "logistic":
+            arr = 1.0 / (1.0 + np.exp(-arr))
+        return arr
+
+    @staticmethod
+    def _dist_stats(scores: np.ndarray) -> dict:
+        return {
+            "metric": "canary_shadow_scores",
+            "score_n": int(len(scores)),
+            "score_mean": float(np.mean(scores)),
+            "score_std": float(np.std(scores)),
+            "score_p10": float(np.percentile(scores, 10)),
+            "score_p50": float(np.percentile(scores, 50)),
+            "score_p90": float(np.percentile(scores, 90)),
+        }
+
+    def _shadow_compare(self, canary: Replica, baseline: Replica,
+                        step: int):
+        """Shadow-score the sampled traffic on the canary and a
+        baseline replica and judge the two score distributions with
+        ``tools/report.py --compare`` (exit 2 = drifted -> reject).
+        Returns (ok, detail)."""
+        sample = list(self._sample)
+        if not sample:
+            return True, ("no traffic sample collected; promoting "
+                          "without a shadow compare")
+        c_scores: list = []
+        b_scores: list = []
+        for path, body in sample:
+            sc = self._shadow_score(canary, path, body)
+            sb = self._shadow_score(baseline, path, body)
+            if sc is None or sb is None or len(sc) != len(sb):
+                continue
+            c_scores.extend(sc)
+            b_scores.extend(sb)
+        if not c_scores:
+            return True, ("shadow replay produced no comparable "
+                          "scores; promoting")
+        stats_b = self._dist_stats(self._gate_scale(b_scores))
+        stats_c = self._dist_stats(self._gate_scale(c_scores))
+        out_dir = os.path.join(
+            os.path.abspath(self.cfg.model_file), "canary_compare",
+            f"step_{step}",
+        )
+        os.makedirs(out_dir, exist_ok=True)
+        path_b = os.path.join(out_dir, "baseline.json")
+        path_c = os.path.join(out_dir, "canary.json")
+        with open(path_b, "w") as f:
+            json.dump(stats_b, f, indent=1)
+        with open(path_c, "w") as f:
+            json.dump(stats_c, f, indent=1)
+        report = os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )),
+            "tools", "report.py",
+        )
+        if os.path.exists(report):
+            proc = subprocess.run(
+                [sys.executable, report, "--compare", path_b, path_c,
+                 "--threshold", "default=0.05"],
+                capture_output=True, timeout=60,
+            )
+            tail = proc.stdout.decode(errors="replace").strip(
+            ).splitlines()[-1:] or [""]
+            detail = (
+                f"report.py --compare exit {proc.returncode} "
+                f"({tail[0]}; artifacts in {out_dir})"
+            )
+            # Exit 0 = within threshold.  Exit 2 = drift.  Anything
+            # else is a tooling failure — reject rather than promote
+            # an unjudged table.
+            return proc.returncode == 0, detail
+        # Degraded in-process gate (report.py missing from this
+        # install): same keys, same 5% ratio rule, flagged loudly.
+        log.warning(
+            "canary compare: %s not found; using the in-process "
+            "ratio gate", report,
+        )
+        for key in ("score_mean", "score_p10", "score_p50",
+                    "score_p90"):
+            va, vb = stats_b[key], stats_c[key]
+            if va == 0 and vb == 0:
+                continue
+            ratio = vb / va if va else float("inf")
+            if not 0.95 <= ratio <= 1.05:
+                return False, (
+                    f"in-process gate: {key} ratio {ratio:.3f} "
+                    f"(artifacts in {out_dir})"
+                )
+        return True, f"in-process gate passed (artifacts in {out_dir})"
+
+    # -- record / metrics ----------------------------------------------------
+
+    def _build(self, kind: str = "status") -> dict:
+        now = time.time()
+        wall = max(now - self._t0, 1e-9)
+        snap = self._tel.snapshot()
+        counters = snap.get("counters") or {}
+        timers = snap.get("timers") or {}
+        with self._lock:
+            per = [
+                {
+                    "index": r.index, "port": r.port, "pid": r.pid,
+                    "healthy": r.healthy,
+                    "quarantined": r.quarantined,
+                    "inflight": r.inflight, "routed": r.routed,
+                }
+                for r in self._replicas
+            ]
+        requests = int(counters.get("serve.router_requests", 0))
+        shed = int(counters.get("serve.shed", 0))
+        block = {
+            "requests": requests,
+            "shed": shed,
+            "shed_frac": round(shed / requests, 6) if requests else 0.0,
+            "qps": round(requests / wall, 2),
+            "inflight": sum(p["inflight"] for p in per),
+            "replicas": len(per),
+            "replicas_healthy": sum(1 for p in per if p["healthy"]),
+            "evictions": int(counters.get("serve.evictions", 0)),
+            "readmissions": int(
+                counters.get("serve.readmissions", 0)
+            ),
+            "retries": int(counters.get("serve.retries", 0)),
+            "canary_promotions": int(
+                counters.get("serve.canary_promotions", 0)
+            ),
+            "canary_rollbacks": int(
+                counters.get("serve.canary_rollbacks", 0)
+            ),
+            "per_replica": per,  # /status detail; non-numeric, so the
+        }                        # Prometheus rendering skips it
+        proxy = timers.get("serve.proxy") or {}
+        for key in ("mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
+            if key in proxy:
+                block[key] = proxy[key]
+        return {
+            "record": kind,
+            "time": now,
+            "elapsed": round(wall, 3),
+            "step": self.step,
+            "serve": block,
+            "stages": snap,
+        }
+
+    def _render_metrics(self) -> str:
+        record = self._build("status")
+        per = record["serve"]["per_replica"]
+        lines = [render_prometheus(record).rstrip("\n")]
+        lines.append("# TYPE tffm_serve_replica_healthy gauge")
+        for p in per:
+            lines.append(
+                f'tffm_serve_replica_healthy{{replica="{p["index"]}",'
+                f'port="{p["port"]}"}} {1 if p["healthy"] else 0}'
+            )
+        lines.append("# TYPE tffm_serve_replica_inflight gauge")
+        for p in per:
+            lines.append(
+                f'tffm_serve_replica_inflight{{replica='
+                f'"{p["index"]}"}} {p["inflight"]}'
+            )
+        lines.append("# TYPE tffm_serve_replica_routed_total counter")
+        for p in per:
+            lines.append(
+                f'tffm_serve_replica_routed_total{{replica='
+                f'"{p["index"]}"}} {p["routed"]}'
+            )
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._httpd.shutdown()
+        self._thread.join()
+        self._httpd.server_close()
+        self._health_thread.join()
+        if self._canary_thread is not None:
+            self._canary_thread.join()
+        with self._lock:
+            pools = list(self._conns.values())
+            self._conns = {}
+        for pool in pools:
+            for conn in pool:
+                conn.close()
+
+
+class FleetHandle:
+    """One running router + replica fleet; ``close()`` tears it down in
+    order (router stops routing, replicas terminate, final record
+    written)."""
+
+    def __init__(self, cfg, manager, router, telemetry, writer,
+                 heartbeat):
+        self.cfg = cfg
+        self.manager = manager
+        self.router = router
+        self.replicas = router._replicas
+        self.telemetry = telemetry
+        self.port = router.port
+        self._writer = writer
+        self._heartbeat = heartbeat
+        self._closed = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._heartbeat is not None:
+            self._heartbeat.close()
+        self.router.close()
+        if self.manager is not None:
+            self.manager.close()
+        if self._writer is not None:
+            try:
+                self._writer.write(self.router._build("final"))
+            except Exception as e:  # noqa: BLE001 - teardown best-effort
+                log.warning("router final record write failed: %s", e)
+            self._writer.close()
+
+
+def start_fleet(cfg: FmConfig, cfg_path: str,
+                overrides: Optional[dict] = None,
+                port: Optional[int] = None) -> FleetHandle:
+    """Spawn the replica fleet and mount the router over it.
+
+    ``port`` overrides ``cfg.serve_port`` (tests pass 0).  The manifest
+    baseline is captured BEFORE the replicas spawn, so a checkpoint
+    published during their warmup still looks new to the canary
+    watcher's first poll.
+    """
+    writer = (
+        obs.JsonlWriter(cfg.metrics_file) if cfg.metrics_file else None
+    )
+    telemetry = obs.Telemetry(enabled=cfg.telemetry)
+    manifest_seen = manifest.read_manifest(cfg.model_file)
+    manager = None
+    router = None
+    heartbeat = None
+    try:
+        manager = ReplicaManager(cfg, cfg_path, overrides=overrides)
+        router = ServeRouter(
+            cfg.serve_port if port is None else port,
+            manager.replicas, cfg, telemetry=telemetry, writer=writer,
+            host=cfg.serve_host, manifest_seen=manifest_seen,
+        )
+        if writer is not None:
+            writer.write({
+                "record": "run_header",
+                "mode": "serve_router",
+                "time": time.time(),
+                "model_file": cfg.model_file,
+                "resume_step": router.step,
+                "batch_size": cfg.batch_size,
+                "telemetry": cfg.telemetry,
+                "heartbeat_secs": cfg.heartbeat_secs,
+                "serve_replicas": cfg.serve_replicas,
+                "serve_shed_deadline_ms": cfg.serve_shed_deadline_ms,
+                "serve_canary": cfg.serve_canary,
+                "serve_transport": cfg.serve_transport,
+                "serve_poll_secs": cfg.serve_poll_secs,
+                "replica_ports": [r.port for r in manager.replicas],
+            })
+        if cfg.heartbeat_secs > 0:
+            heartbeat = obs.Heartbeat(
+                cfg.heartbeat_secs,
+                lambda: router._build("heartbeat"),
+                writer=writer,
+            )
+    except BaseException:
+        # A failed mount must not leak replica processes or threads.
+        if router is not None:
+            router.close()
+        if manager is not None:
+            manager.close()
+        if writer is not None:
+            writer.close()
+        raise
+    log.info(
+        "router listening on %s:%d over %d replicas (POST /score, "
+        "/score_bin; GET /metrics, /status, /healthz)",
+        cfg.serve_host, router.port, len(manager.replicas),
+    )
+    return FleetHandle(cfg, manager, router, telemetry, writer,
+                       heartbeat)
+
+
+def serve_fleet(cfg: FmConfig, cfg_path: str,
+                overrides: Optional[dict] = None) -> int:
+    """CLI entry for ``run_tffm.py serve <cfg> --replicas N``: route
+    until interrupted.  SIGTERM and SIGINT both tear the fleet down —
+    the replica subprocesses must never outlive their router."""
+    handle = start_fleet(cfg, cfg_path, overrides=overrides)
+    print(
+        f"routing on {cfg.serve_host}:{handle.port} across "
+        f"{len(handle.replicas)} replica(s)", flush=True,
+    )
+
+    def _sigterm(signum, frame):  # pragma: no cover - signal path
+        raise KeyboardInterrupt
+
+    prev = signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        log.info("interrupted; shutting down the fleet")
+    finally:
+        handle.close()
+        signal.signal(signal.SIGTERM, prev)
+    return 0

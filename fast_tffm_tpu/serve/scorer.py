@@ -117,6 +117,12 @@ class _LadderScorer:
         self._writer = writer
         self._lock = threading.Lock()  # serializes dispatch + pools
         self._swap_lock = threading.Lock()
+        # Previous model retained by a keep_prev swap (the canary
+        # protocol's rollback window): (model_ref, step) or None.
+        # Holding it costs one standby table's memory, so it exists
+        # only between a keep_prev swap and the promote()/rollback()
+        # decision.
+        self._prev = None
         self._cache: dict = {}
         self._pools: dict = {}  # rung -> (ids, vals, fields) host buffers
         self._aot_broken = False
@@ -274,7 +280,37 @@ class _LadderScorer:
                     fields[:] = 0
             return self._dispatch_rung(ids, vals, fields, b)
 
+    # -- canary promote / rollback -------------------------------------
+
+    def promote(self) -> None:
+        """Drop the previous model a ``keep_prev`` swap retained: the
+        new params are now the fleet's truth and the rollback window is
+        closed (frees the standby table's memory)."""
+        with self._swap_lock:
+            self._prev = None
+
+    def rollback(self) -> bool:
+        """Restore the model a ``keep_prev`` swap replaced (the canary
+        failed its shadow compare).  One reference swap between
+        dispatches, same never-torn contract as :meth:`swap`.  Returns
+        False when there is nothing to roll back to."""
+        with self._swap_lock:
+            if self._prev is None:
+                return False
+            model, step = self._prev
+            self._prev = None
+            self._set_model(model)
+            self.step = int(step)
+        self._c_swaps.add()
+        log.info("serving params rolled back to step %d", step)
+        return True
+
     # -- subclass hooks ------------------------------------------------
+
+    def _set_model(self, model) -> None:
+        """Install a model reference (rollback path); caller holds
+        ``_swap_lock``."""
+        raise NotImplementedError
 
     def _warm_rung(self, b: int) -> None:
         raise NotImplementedError
@@ -499,19 +535,37 @@ class FixedShapeScorer(_LadderScorer):
         self._g_quant_err.set(float(err))
         return placed
 
-    def swap(self, params, step: int = 0) -> None:
+    def swap(self, params, step: int = 0, keep_prev: bool = False
+             ) -> None:
         """Warm hot-swap: stage the new params into standby device
         buffers (off the dispatch lock — traffic keeps scoring the old
         table; a quantized scorer quantizes the incoming fp32 table
         here too), then swap the reference atomically between
         dispatches.  Shapes are unchanged, so the compiled rungs serve
-        on with zero recompiles; no request ever sees a torn table."""
+        on with zero recompiles; no request ever sees a torn table.
+        ``keep_prev`` retains the replaced model for a later
+        :meth:`rollback` (the canary window) at the cost of one standby
+        table's memory until :meth:`promote`."""
         placed = self._place(params)  # standby buffers, fully resident
         with self._swap_lock:
+            if keep_prev:
+                # ANCHOR, don't clobber: if a rollback window is
+                # already open (a canary check that died between its
+                # reload and its verdict retries the reload), the
+                # restorable params must stay the last VETTED ones —
+                # overwriting them with the current (unvetted) model
+                # would make a later rollback silently a no-op.
+                if self._prev is None:
+                    self._prev = (self._params, self.step)
+            else:
+                self._prev = None
             self._params = placed
             self.step = int(step)
         self._c_swaps.add()
         log.info("serving params hot-swapped to step %d", step)
+
+    def _set_model(self, model) -> None:
+        self._params = model
 
     def _compiled(self, b: int):
         fn = self._cache.get(b)
@@ -604,15 +658,29 @@ class OverlayScorer(_LadderScorer):
         )
         self._dim = dim
 
-    def swap(self, w0: float, store, step: int = 0) -> None:
+    def swap(self, w0: float, store, step: int = 0,
+             keep_prev: bool = False) -> None:
         """Hot-swap to a freshly restored overlay (new cold store +
         scalars).  One reference swap between dispatches — a chunk
-        gathers its compact table from exactly one store."""
+        gathers its compact table from exactly one store.
+        ``keep_prev`` retains the replaced overlay for
+        :meth:`rollback` until :meth:`promote`."""
         with self._swap_lock:
+            if keep_prev:
+                # Same anchoring rule as the dense scorer: an open
+                # rollback window keeps pointing at the last vetted
+                # overlay across repeated keep_prev swaps.
+                if self._prev is None:
+                    self._prev = (self._model, self.step)
+            else:
+                self._prev = None
             self._model = (np.float32(w0), store)
             self.step = int(step)
         self._c_swaps.add()
         log.info("serving overlay hot-swapped to step %d", step)
+
+    def _set_model(self, model) -> None:
+        self._model = model
 
     def _compiled(self, b: int, rows: int):
         key = (b, rows)

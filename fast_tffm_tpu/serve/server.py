@@ -9,6 +9,16 @@ daemon thread, read-only observability routes, degrade-don't-die):
   ignored; lines whose first token contains ``:`` are accepted
   label-less).  Response: one score per non-blank line, ``%.6f`` —
   byte-identical formatting to offline ``predict``'s ``score_path``.
+- ``POST /score_bin`` — the binary request transport: one
+  length-prefixed little-endian frame of id/value/field arrays (layout
+  at the codec below and in SERVING.md), decoded by ``np.frombuffer``
+  so the hot path skips text parsing entirely; scores come back as one
+  binary frame.  Bitwise-identical scores to ``/score`` for the same
+  examples.  ``serve_transport`` gates which of the two are enabled.
+- ``POST /reload`` / ``/promote`` / ``/rollback`` — the admin swap
+  surface the router's canary promotion drives: reload the current
+  manifest's checkpoint keeping the replaced params restorable, close
+  the rollback window, or restore them.
 - ``GET /metrics`` / ``/status`` / ``/healthz`` — the live
   observability surface, rendered by the same
   ``obs.status.render_prometheus`` the trainer's endpoint uses; all
@@ -32,10 +42,10 @@ and retries at the next poll.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
-from http.server import ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
@@ -43,20 +53,30 @@ import numpy as np
 from fast_tffm_tpu import obs
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data import libsvm
-from fast_tffm_tpu.obs.status import QuietHandler
+from fast_tffm_tpu.obs.status import ObsHTTPServer, QuietHandler
+from fast_tffm_tpu.serve import wire
 from fast_tffm_tpu.serve.batcher import ServeBatcher
 from fast_tffm_tpu.serve import scorer as scorer_lib
 from fast_tffm_tpu.train import checkpoint
 
 log = logging.getLogger(__name__)
 
-# POST /score body cap: far above any sane scoring request (a 64 MiB
-# libsvm body is ~1M examples), far below what would hurt the host.
-_MAX_BODY_BYTES = 64 << 20
+# Backward-compatible spellings: the codec (and the shared POST body
+# cap) live in serve/wire.py — jax-free so the router process can
+# decode frames without a jax import — and re-export here where the
+# serving tests and clients historically found them.
+_MAX_BODY_BYTES = wire.MAX_BODY_BYTES
+BIN_MAGIC = wire.BIN_MAGIC
+decode_bin_request = wire.decode_bin_request
+decode_bin_response = wire.decode_bin_response
+encode_bin_request = wire.encode_bin_request
+encode_bin_response = wire.encode_bin_response
 
 __all__ = [
-    "CheckpointWatcher", "ServeHandle", "ServeServer", "parse_request",
-    "serve", "serve_forever",
+    "BIN_MAGIC", "CheckpointWatcher", "ServeHandle", "ServeServer",
+    "decode_bin_request", "decode_bin_response", "encode_bin_request",
+    "encode_bin_response", "parse_request", "reload_scorer", "serve",
+    "serve_forever",
 ]
 
 
@@ -110,6 +130,34 @@ def parse_request(text: str, cfg: FmConfig):
     return ids, vals, fields, n, truncated
 
 
+def reload_scorer(cfg: FmConfig, scorer, keep_prev: bool = False) -> int:
+    """Reload ``cfg.model_file``'s checkpoint into the running scorer
+    (standby buffers, then one reference swap — never torn).  Returns
+    the new step.  Raises ValueError on a config<->checkpoint
+    contradiction, including a dense<->tiered FORMAT flip a running
+    scorer cannot cross.  Shared by the poll watcher, the ``/reload``
+    admin route, and the router's canary protocol (which passes
+    ``keep_prev=True`` to hold the rollback window open)."""
+    fmt, step, model = scorer_lib.load_model(cfg, mesh=scorer.mesh)
+    if fmt == "tiered" and isinstance(scorer, scorer_lib.OverlayScorer):
+        scorer.swap(*model, step=step, keep_prev=keep_prev)
+    elif fmt in ("dense", "quant") and isinstance(
+        scorer, scorer_lib.FixedShapeScorer
+    ):
+        # A dense checkpoint swaps into any table dtype (a quantized
+        # scorer re-quantizes it off-traffic); a quant checkpoint must
+        # match the scorer's dtype/chunk — load_model/swap raise
+        # ValueError on mismatch.
+        scorer.swap(model, step=step, keep_prev=keep_prev)
+    else:
+        raise ValueError(
+            f"checkpoint at {cfg.model_file} changed FORMAT ({fmt}) "
+            "mid-serve; a running server cannot cross dense<->tiered "
+            "— restart to pick it up"
+        )
+    return step
+
+
 class CheckpointWatcher:
     """Poll the save-path manifest; hot-swap the scorer on a new step.
 
@@ -151,31 +199,7 @@ class CheckpointWatcher:
         if man is None or man == self._seen:
             return
         try:
-            fmt, step, model = scorer_lib.load_model(
-                self._cfg, mesh=self._scorer.mesh
-            )
-            scorer = self._scorer
-            if fmt == "tiered" and isinstance(
-                scorer, scorer_lib.OverlayScorer
-            ):
-                scorer.swap(*model, step=step)
-            elif fmt in ("dense", "quant") and isinstance(
-                scorer, scorer_lib.FixedShapeScorer
-            ):
-                # A dense checkpoint swaps into any table dtype (a
-                # quantized scorer re-quantizes it off-traffic); a
-                # quant checkpoint must match the scorer's
-                # dtype/chunk — mismatches raise ValueError below.
-                scorer.swap(model, step=step)
-            else:
-                log.warning(
-                    "checkpoint at %s changed FORMAT (%s) mid-serve; "
-                    "a running server cannot cross dense<->tiered — "
-                    "restart to pick it up",
-                    self._cfg.model_file, fmt,
-                )
-                self._seen = man
-                return
+            step = reload_scorer(self._cfg, self._scorer)
         except ValueError as e:
             # A ValueError out of load_model/swap is a PERMANENT
             # config<->checkpoint contradiction (serve_table_dtype or
@@ -202,89 +226,159 @@ class CheckpointWatcher:
 
 
 class ServeServer:
-    """HTTP front door: ``POST /score`` + the observability routes."""
+    """HTTP front door: ``POST /score`` (libsvm text) + ``POST
+    /score_bin`` (the binary frame transport, gated by
+    ``serve_transport``), the admin routes the router's canary
+    protocol drives (``/reload`` / ``/promote`` / ``/rollback``), and
+    the observability routes."""
 
     def __init__(self, port: int, batcher: ServeBatcher, cfg: FmConfig,
                  build, telemetry=None, host: str = "127.0.0.1",
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, scorer=None):
         tel = telemetry if telemetry is not None else obs.NULL
         requests_c = tel.counter("serve.http_requests")
         truncated_c = tel.counter("serve.truncated_features")
         # Per-request libsvm-text parse time: PR 9 flagged text parsing
         # as measurable host latency at small requests — this timer
-        # makes it a measured number (/metrics + the bench serve
-        # section) instead of an assumption, and the datum a future
-        # binary transport would be judged against.
+        # made it a measured number, and the binary transport's
+        # serve.parse_bin twin is the datum that shows what removing
+        # the text parse actually buys (bench: serve_parse_p50_ms vs
+        # serve_bin_p50_ms).
         parse_t = tel.timer("serve.parse")
+        parse_bin_t = tel.timer("serve.parse_bin")
+        # The admin swap surface is driven over HTTP by the router's
+        # canary protocol; one at a time (a reload stages a whole
+        # standby table — two concurrent ones would race the rollback
+        # window).
+        admin_lock = threading.Lock()
         server = self
+
+        def score_arrays(handler, ids, vals, fields, n, truncated,
+                         encode) -> None:
+            """Shared tail of both transports: count integrity events,
+            batch-score, encode the response."""
+            if truncated:
+                # Same integrity signal the ingest path counts: a
+                # truncated example scores as a different example.
+                truncated_c.add(truncated)
+            if n == 0:
+                ctype, body = encode(np.zeros((0,), np.float32))
+                handler._send(200, body, ctype)
+                return
+            try:
+                scores = batcher.score(
+                    ids, vals,
+                    fields if cfg.field_num else None,
+                    timeout=timeout_s,
+                )
+            except Exception as e:  # noqa: BLE001 - report, don't die
+                handler._send(
+                    503, f"scoring failed: {e}\n".encode(), "text/plain"
+                )
+                return
+            ctype, body = encode(scores)
+            handler._send(200, body, ctype)
+
+        def encode_text(scores):
+            return "text/plain", "".join(
+                f"{s:.6f}\n" for s in scores
+            ).encode()
+
+        def encode_bin(scores):
+            return "application/octet-stream", encode_bin_response(scores)
 
         class Handler(QuietHandler):
             def do_POST(self) -> None:  # noqa: N802 - http.server API
                 requests_c.add()
-                if self.path.partition("?")[0] != "/score":
+                path, _, query = self.path.partition("?")
+                if path in ("/reload", "/promote", "/rollback"):
+                    self._do_admin(path, query)
+                    return
+                if path not in ("/score", "/score_bin"):
                     self._send(404, b"not found\n", "text/plain")
                     return
-                if "Content-Length" not in self.headers:
-                    # Without a length the body is unreadable here
-                    # (chunked encoding): answering 200-empty would
-                    # silently drop the client's examples.
+                want = "text" if path == "/score" else "bin"
+                if cfg.serve_transport not in (want, "both"):
                     self._send(
-                        411, b"Content-Length required (chunked "
-                             b"transfer is not supported)\n",
+                        404, f"transport {want!r} disabled "
+                             f"(serve_transport="
+                             f"{cfg.serve_transport})\n".encode(),
                         "text/plain",
                     )
                     return
+                body = self._read_body(_MAX_BODY_BYTES)
+                if body is None:
+                    return  # error response already sent
                 try:
-                    length = int(self.headers["Content-Length"])
-                except ValueError:
-                    self._send(400, b"bad Content-Length\n", "text/plain")
-                    return
-                # The client's length is untrusted input on an
-                # unauthenticated endpoint: a negative value would
-                # read-to-EOF (handler thread pinned until the client
-                # hangs up), an absurd one would buffer it all.
-                if length < 0:
-                    self._send(400, b"bad Content-Length\n", "text/plain")
-                    return
-                if length > _MAX_BODY_BYTES:
-                    self._send(
-                        413, f"request body over the "
-                             f"{_MAX_BODY_BYTES >> 20} MiB cap; split "
-                             f"it\n".encode(), "text/plain",
-                    )
-                    return
-                try:
-                    text = self.rfile.read(length).decode()
-                    with parse_t.time():
-                        ids, vals, fields, n, truncated = parse_request(
-                            text, cfg
-                        )
+                    if path == "/score":
+                        with parse_t.time():
+                            parsed = parse_request(body.decode(), cfg)
+                    else:
+                        with parse_bin_t.time():
+                            parsed = decode_bin_request(body, cfg)
                 except (ValueError, UnicodeDecodeError) as e:
                     self._send(
                         400, f"bad request: {e}\n".encode(), "text/plain"
                     )
                     return
-                if truncated:
-                    # Same integrity signal the ingest path counts: a
-                    # truncated example scores as a different example.
-                    truncated_c.add(truncated)
-                if n == 0:
-                    self._send(200, b"", "text/plain")
-                    return
-                try:
-                    scores = batcher.score(
-                        ids, vals,
-                        fields if cfg.field_num else None,
-                        timeout=timeout_s,
-                    )
-                except Exception as e:  # noqa: BLE001 - report, don't die
+                ids, vals, fields, n, truncated = parsed
+                score_arrays(
+                    self, ids, vals, fields, n, truncated,
+                    encode_text if path == "/score" else encode_bin,
+                )
+
+            def _do_admin(self, path: str, query: str) -> None:
+                """The canary-protocol swap surface.  ``/reload``
+                loads the CURRENT manifest's checkpoint into standby
+                buffers and swaps; only ``/reload?keep_prev=1`` (the
+                router's canary reload) retains the replaced params
+                for ``/rollback`` — a plain reload must not pin a
+                second table in device memory (nothing in a
+                non-canary deployment would ever ``/promote`` it
+                away), and without a retained window ``/rollback`` is
+                a 409, so a stray admin call cannot flip the served
+                model.  All three answer JSON with the served step."""
+                if scorer is None:
                     self._send(
-                        503, f"scoring failed: {e}\n".encode(),
+                        503, b"no admin scorer on this endpoint\n",
                         "text/plain",
                     )
                     return
-                body = "".join(f"{s:.6f}\n" for s in scores).encode()
-                self._send(200, body, "text/plain")
+                # Consume a (normally empty) body so keep-alive stays
+                # intact for admin clients that send one.
+                if self._read_body(_MAX_BODY_BYTES) is None:
+                    return
+                with admin_lock:
+                    try:
+                        if path == "/reload":
+                            reload_scorer(
+                                cfg, scorer,
+                                keep_prev="keep_prev=1" in query,
+                            )
+                        elif path == "/promote":
+                            scorer.promote()
+                        else:
+                            if not scorer.rollback():
+                                self._send(
+                                    409, b"nothing to roll back to (no "
+                                         b"keep-prev swap is open)\n",
+                                    "text/plain",
+                                )
+                                return
+                    except ValueError as e:
+                        self._send(
+                            409, f"{e}\n".encode(), "text/plain"
+                        )
+                        return
+                    except Exception as e:  # noqa: BLE001 - report
+                        self._send(
+                            500, f"{path} failed: {e}\n".encode(),
+                            "text/plain",
+                        )
+                        return
+                    body = (json.dumps({"step": scorer.step}) + "\n"
+                            ).encode()
+                self._send(200, body, "application/json")
 
             def do_GET(self) -> None:  # noqa: N802 - http.server API
                 requests_c.add()
@@ -294,8 +388,7 @@ class ServeServer:
                 self._send(404, b"not found\n", "text/plain")
 
         self._build = build
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = ObsHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="tffm-serve-http",
@@ -368,6 +461,7 @@ def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
         "examples": int(counters.get("serve.examples", 0)),
         "batches": int(counters.get("serve.batches", 0)),
         "qps": round(requests / wall, 2) if wall > 0 else 0.0,
+        "inflight": int(gauges.get("serve.inflight", 0)),
         "batch_fill": round(batcher.batch_fill, 6),
         "swaps": int(counters.get("serve.swaps", 0)),
         "compiles": int(scorer.compiles),
@@ -400,6 +494,9 @@ def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
     parse = timers.get("serve.parse") or {}
     if "p50_ms" in parse:
         out["parse_p50_ms"] = parse["p50_ms"]
+    parse_bin = timers.get("serve.parse_bin") or {}
+    if "p50_ms" in parse_bin:
+        out["parse_bin_p50_ms"] = parse_bin["p50_ms"]
     return out
 
 
@@ -466,6 +563,7 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             "serve_batch_sizes": list(scorer.ladder),
             "max_batch_wait_ms": cfg.max_batch_wait_ms,
             "serve_poll_secs": cfg.serve_poll_secs,
+            "serve_transport": cfg.serve_transport,
             "batch_size": cfg.batch_size,
             "telemetry": cfg.telemetry,
             "heartbeat_secs": cfg.heartbeat_secs,
@@ -485,7 +583,8 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             )
         server = ServeServer(
             cfg.serve_port if port is None else port,
-            batcher, cfg, build, telemetry=telemetry, host=cfg.serve_host,
+            batcher, cfg, build, telemetry=telemetry,
+            host=cfg.serve_host, scorer=scorer,
         )
     except BaseException:
         # A taken port (or watcher failure) must not leak the batcher

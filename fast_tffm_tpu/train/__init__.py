@@ -1,1 +1,15 @@
-from fast_tffm_tpu.train.optimizers import make_optimizer  # noqa: F401
+# Lazy re-export (PEP 562): optimizers pulls in jax/optax, but this
+# package also hosts train.manifest — the stdlib-only manifest reader
+# the jax-free serving router polls — so the heavy import happens only
+# when make_optimizer is actually touched.
+__all__ = ["make_optimizer"]
+
+
+def __getattr__(name: str):
+    if name == "make_optimizer":
+        from fast_tffm_tpu.train.optimizers import make_optimizer
+
+        return make_optimizer
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

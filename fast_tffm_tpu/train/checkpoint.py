@@ -19,7 +19,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import time
 from typing import Any, Optional
 
 import jax
@@ -95,34 +94,15 @@ def save(
     log.info("saved checkpoint step=%d to %s", step, model_file)
 
 
-def _manifest_path(model_file: str) -> str:
-    return os.path.join(os.path.abspath(model_file), "serve_manifest.json")
-
-
-def _publish_manifest(model_file: str, step: int, fmt: str) -> None:
-    """Publish the serving manifest AFTER the checkpoint files land.
-
-    The manifest is the hot-swap handshake with the serving path
-    (serve.CheckpointWatcher): because it is written last (atomic
-    rename), a server that sees a new manifest knows the checkpoint it
-    names is complete.  ``published`` disambiguates re-saves at the
-    same step (a warm restart that trains zero new steps still
-    republishes).
-    """
-    doc = {"step": int(step), "format": fmt, "published": time.time()}
-    tmp = _manifest_path(model_file) + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f)
-    os.replace(tmp, _manifest_path(model_file))
-
-
-def read_manifest(model_file: str) -> Optional[dict]:
-    """The published serving manifest, or None (absent / mid-write)."""
-    try:
-        with open(_manifest_path(model_file)) as f:
-            return json.load(f)
-    except (FileNotFoundError, json.JSONDecodeError):
-        return None
+# The manifest is the hot-swap handshake with the serving path
+# (serve.CheckpointWatcher and the router's canary watcher): written
+# last, atomic rename, so a published step always names a complete
+# checkpoint.  The helpers live in train/manifest.py (stdlib-only —
+# the router process polls them without a jax import) and are
+# re-exported here for this module's historical callers.
+from fast_tffm_tpu.train.manifest import (  # noqa: E402,F401
+    _manifest_path, _publish_manifest, read_manifest,
+)
 
 
 def restore_data_state(model_file: str) -> Optional[dict]:

@@ -1,0 +1,45 @@
+"""The serving manifest: the hot-swap handshake file, stdlib-only.
+
+``checkpoint.py``'s save paths publish ``serve_manifest.json`` AFTER
+the checkpoint files land (atomic rename), so a reader that sees a new
+manifest knows the checkpoint it names is complete.  The helpers live
+here — json/os/time only, no jax, no orbax — because the serving
+ROUTER process polls the manifest too and must stay jax-free
+(serve/router.py); checkpoint.py re-exports them for its callers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+__all__ = ["read_manifest"]
+
+
+def _manifest_path(model_file: str) -> str:
+    return os.path.join(os.path.abspath(model_file),
+                        "serve_manifest.json")
+
+
+def _publish_manifest(model_file: str, step: int, fmt: str) -> None:
+    """Publish the serving manifest AFTER the checkpoint files land.
+
+    ``published`` disambiguates re-saves at the same step (a warm
+    restart that trains zero new steps still republishes).
+    """
+    doc = {"step": int(step), "format": fmt, "published": time.time()}
+    tmp = _manifest_path(model_file) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, _manifest_path(model_file))
+
+
+def read_manifest(model_file: str) -> Optional[dict]:
+    """The published serving manifest, or None (absent / mid-write)."""
+    try:
+        with open(_manifest_path(model_file)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None

@@ -1,5 +1,12 @@
 #!/usr/bin/env python
-"""Entry point matching the reference CLI: run_tffm.py {train|predict} <cfg>."""
+"""Entry point matching the reference CLI:
+run_tffm.py {train|predict|serve} <cfg>.
+
+serve mode mounts the HTTP scoring endpoint (SERVING.md); with
+--replicas N (N >= 2) it launches N shared-nothing replica serve
+processes behind the power-of-two-choices router in
+fast_tffm_tpu/serve/router.py.
+"""
 
 import sys
 

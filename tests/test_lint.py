@@ -157,6 +157,48 @@ class TestLifecycle:
         rules = {f.rule for f in _findings(LifecycleRule(), ctx)}
         assert rules == {"TL002", "TL003", "TL004"}
 
+    def test_unreaped_popen_flagged(self, tmp_path):
+        """TL006 (ISSUE 12): a subprocess.Popen replica process with
+        no reachable terminate/wait on the owner's teardown path would
+        outlive its router — an orphaned jax process holding a port."""
+        ctx = _mini_repo(tmp_path, """\
+            import subprocess
+
+            class Manager:
+                def __init__(self, cmd):
+                    self.proc = subprocess.Popen(cmd)
+            """)
+        found = _by_rule(_findings(LifecycleRule(), ctx), "TL006")
+        assert len(found) == 1
+        assert found[0].line == 5
+        assert "subprocess" in found[0].message
+
+    def test_popen_with_teardown_passes(self, tmp_path):
+        ctx = _mini_repo(tmp_path, """\
+            import subprocess
+
+            class Manager:
+                def __init__(self, cmd):
+                    self.proc = subprocess.Popen(cmd)
+
+                def close(self):
+                    if self.proc.poll() is None:
+                        self.proc.terminate()
+                    self.proc.wait()
+            """)
+        assert not _findings(LifecycleRule(), ctx)
+
+    def test_local_popen_unreaped_flagged(self, tmp_path):
+        ctx = _mini_repo(tmp_path, """\
+            import subprocess
+
+            def launch(cmd):
+                proc = subprocess.Popen(cmd)
+                proc.communicate()
+            """)
+        found = _by_rule(_findings(LifecycleRule(), ctx), "TL006")
+        assert len(found) == 1
+
     def test_ownership_transfer_not_flagged(self, tmp_path):
         ctx = _mini_repo(tmp_path, """\
             from multiprocessing import shared_memory

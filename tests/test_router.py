@@ -1,0 +1,723 @@
+"""Scale-out serving router (ISSUE 12 tentpole): P2C dispatch, health
+eviction/readmission, deadline-budget load shedding, canary promotion.
+
+The router logic tests run against FAKE replicas — tiny stdlib HTTP
+servers speaking exactly the replica surface the router uses
+(``/score``, ``/score_bin``, ``/healthz``, ``/reload``/``/promote``/
+``/rollback``) — so dispatch/eviction/canary semantics are pinned
+without spawning jax subprocesses.  The real-scorer integration (a
+router over a live serve stack, binary==text bitwise parity) lives at
+the bottom and in tests/test_serving.py.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.obs.status import ObsHTTPServer, QuietHandler
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.serve import wire
+from fast_tffm_tpu.serve.router import Replica, ServeRouter
+from fast_tffm_tpu.train import checkpoint
+
+
+class FakeReplica:
+    """A stdlib stand-in for one replica serve process.
+
+    Scores every example ``self.score`` (so which table "version" a
+    response came from is readable off the wire), counts requests and
+    distinct connections, and implements the admin swap surface with
+    the same keep-prev/rollback semantics as the real scorer.
+    """
+
+    def __init__(self, score=0.5, delay_s=0.0):
+        self.score = score
+        self.delay_s = delay_s
+        self.healthy = True
+        self.step = 0
+        self.pending = None      # (score, step) the next /reload installs
+        self.prev = None         # what /rollback restores
+        self.reload_calls = 0
+        self.promote_calls = 0
+        self.rollback_calls = 0
+        self.requests = 0
+        self.connections = 0
+        self.reload_status = 200
+        self.rollback_status = 200
+        fake = self
+
+        class Handler(QuietHandler):
+            def setup(self) -> None:
+                fake.connections += 1
+                super().setup()
+
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path == "/healthz" and fake.healthy:
+                    self._send(200, b"ok\n", "text/plain")
+                else:
+                    self._send(503, b"unhealthy\n", "text/plain")
+
+            def do_POST(self) -> None:  # noqa: N802
+                body = self._read_body(wire.MAX_BODY_BYTES)
+                if body is None:
+                    return
+                fake.requests += 1
+                if fake.delay_s:
+                    time.sleep(fake.delay_s)
+                path, _, query = self.path.partition("?")
+                self.path = path
+                if self.path == "/score":
+                    n = len([
+                        l for l in body.decode().splitlines()
+                        if l.strip()
+                    ])
+                    out = "".join(f"{fake.score:.6f}\n" for _ in
+                                  range(n))
+                    self._send(200, out.encode(), "text/plain")
+                elif self.path == "/score_bin":
+                    _ids, _vals, _f, n, _tr = wire.decode_bin_request(
+                        body, FakeReplica._CFG
+                    )
+                    self._send(
+                        200,
+                        wire.encode_bin_response(
+                            np.full((n,), fake.score, np.float32)
+                        ),
+                        "application/octet-stream",
+                    )
+                elif self.path == "/reload":
+                    fake.reload_calls += 1
+                    if fake.reload_status != 200:
+                        self._send(
+                            fake.reload_status, b"refused\n",
+                            "text/plain",
+                        )
+                        return
+                    if fake.pending is not None:
+                        # Same contract as the real scorer: only a
+                        # keep_prev reload opens (or anchors) the
+                        # rollback window.
+                        if "keep_prev=1" in query:
+                            if fake.prev is None:
+                                fake.prev = (fake.score, fake.step)
+                        else:
+                            fake.prev = None
+                        fake.score, fake.step = fake.pending
+                    self._send(
+                        200,
+                        (json.dumps({"step": fake.step}) + "\n"
+                         ).encode(),
+                        "application/json",
+                    )
+                elif self.path == "/promote":
+                    fake.promote_calls += 1
+                    fake.prev = None
+                    self._send(
+                        200,
+                        (json.dumps({"step": fake.step}) + "\n"
+                         ).encode(),
+                        "application/json",
+                    )
+                elif self.path == "/rollback":
+                    fake.rollback_calls += 1
+                    if fake.rollback_status != 200:
+                        self._send(
+                            fake.rollback_status, b"broken\n",
+                            "text/plain",
+                        )
+                        return
+                    if fake.prev is None:
+                        self._send(409, b"nothing to roll back\n",
+                                   "text/plain")
+                        return
+                    fake.score, fake.step = fake.prev
+                    fake.prev = None
+                    self._send(
+                        200,
+                        (json.dumps({"step": fake.step}) + "\n"
+                         ).encode(),
+                        "application/json",
+                    )
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+
+        self._httpd = ObsHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+        )
+        self._thread.start()
+
+    _CFG = FmConfig(vocabulary_size=256, factor_num=4, max_features=4)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join()
+        self._httpd.server_close()
+
+
+def _mk_router(fakes, tmp_path, health_secs=10.0, **cfg_kw):
+    """A router over fakes.  health_secs defaults high so dispatch
+    tests control health state themselves."""
+    defaults = dict(
+        vocabulary_size=256, factor_num=4, max_features=4,
+        model_file=str(tmp_path / "model"),
+        serve_replicas=max(2, len(fakes)),
+    )
+    defaults.update(cfg_kw)
+    cfg = FmConfig(**defaults)
+    replicas = [
+        Replica(i, "127.0.0.1", f.port) for i, f in enumerate(fakes)
+    ]
+    tel = obs.Telemetry()
+    router = ServeRouter(
+        0, replicas, cfg, telemetry=tel, health_secs=health_secs,
+    )
+    return router, replicas, tel
+
+
+def _post(port, path, body, timeout=30):
+    """(status, body bytes); HTTPError codes return instead of raising."""
+    try:
+        resp = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body, method="POST",
+        ), timeout=timeout)
+        return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestDispatch:
+    def test_p2c_picks_the_less_loaded_of_two(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, reps, _ = _mk_router(fakes, tmp_path)
+        try:
+            # Load replica 0 far beyond what 10 admissions can close:
+            # every admission must pick replica 1 (P2C with two
+            # replicas compares both).
+            reps[0].inflight = 20
+            picks = []
+            for _ in range(10):
+                rep, why = router._admit()
+                assert why is None
+                picks.append(rep.index)
+            assert picks == [1] * 10
+            # Flip the imbalance: admission follows the load.
+            with router._lock:
+                reps[1].inflight = 50
+            rep, _ = router._admit()
+            assert rep.index == 0
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_routes_score_and_counts(self, tmp_path):
+        fakes = [FakeReplica(score=0.25), FakeReplica(score=0.25)]
+        router, reps, tel = _mk_router(fakes, tmp_path)
+        try:
+            status, body = _post(router.port, "/score", b"1 3:1\n")
+            assert status == 200
+            assert body.decode().strip() == "0.250000"
+            blk = router._build()["serve"]
+            assert blk["requests"] == 1
+            assert blk["replicas_healthy"] == 2
+            assert sum(p["routed"] for p in blk["per_replica"]) == 1
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_binary_transport_proxies(self, tmp_path):
+        fakes = [FakeReplica(score=0.75), FakeReplica(score=0.75)]
+        router, _, _ = _mk_router(fakes, tmp_path)
+        try:
+            ids = np.zeros((3, 4), np.int32)
+            vals = np.ones((3, 4), np.float32)
+            status, raw = _post(
+                router.port, "/score_bin",
+                wire.encode_bin_request(ids, vals),
+            )
+            assert status == 200
+            np.testing.assert_array_equal(
+                wire.decode_bin_response(raw),
+                np.full((3,), 0.75, np.float32),
+            )
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_transport_knob_gates_routes(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, _, _ = _mk_router(
+            fakes, tmp_path, serve_transport="bin"
+        )
+        try:
+            status, body = _post(router.port, "/score", b"1 3:1\n")
+            assert status == 404
+            assert b"disabled" in body
+            ids = np.zeros((1, 4), np.int32)
+            status, _ = _post(
+                router.port, "/score_bin",
+                wire.encode_bin_request(ids, np.ones((1, 4),
+                                                     np.float32)),
+            )
+            assert status == 200
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_keepalive_through_the_router(self, tmp_path):
+        """One client connection carries many requests (HTTP/1.1
+        keep-alive on the front), and the router reuses its replica
+        connections (far fewer backend connections than requests)."""
+        fakes = [FakeReplica(), FakeReplica()]
+        router, _, _ = _mk_router(fakes, tmp_path)
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", router.port, timeout=10
+            )
+            for _ in range(10):
+                conn.request("POST", "/score", body=b"1 3:1\n",
+                             headers={"Content-Type": "text/plain"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                resp.read()
+                assert not resp.will_close  # front keep-alive held
+            conn.close()
+            backend_conns = sum(f.connections for f in fakes)
+            backend_requests = sum(f.requests for f in fakes)
+            assert backend_requests == 10
+            # Health probes are off (health_secs high): every backend
+            # connection here is a proxy connection, and pooling must
+            # keep them well below one per request.
+            assert backend_conns <= 4
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
+class TestHealth:
+    def test_eviction_and_readmission(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, reps, tel = _mk_router(
+            fakes, tmp_path, health_secs=0.05
+        )
+        try:
+            fakes[0].healthy = False
+            deadline = time.time() + 10
+            while reps[0].healthy and time.time() < deadline:
+                time.sleep(0.02)
+            assert not reps[0].healthy, "replica never evicted"
+            # Traffic keeps flowing on the survivor.
+            for _ in range(5):
+                status, _ = _post(router.port, "/score", b"1 3:1\n")
+                assert status == 200
+            assert fakes[1].requests >= 5
+            assert fakes[0].requests == 0
+            # Recovery: the health loop readmits it.
+            fakes[0].healthy = True
+            deadline = time.time() + 10
+            while not reps[0].healthy and time.time() < deadline:
+                time.sleep(0.02)
+            assert reps[0].healthy, "replica never readmitted"
+            counters = tel.snapshot()["counters"]
+            assert counters["serve.evictions"] == 1
+            assert counters["serve.readmissions"] == 1
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_dead_replica_request_retries_transparently(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, reps, tel = _mk_router(fakes, tmp_path)
+        try:
+            # Kill replica 0's server outright; the router only learns
+            # at proxy time (health probes are off at this cadence).
+            fakes[0].close()
+            ok = 0
+            for _ in range(10):
+                status, _ = _post(router.port, "/score", b"1 3:1\n")
+                ok += 1 if status == 200 else 0
+            assert ok == 10, "requests were lost on the dead replica"
+            counters = tel.snapshot()["counters"]
+            assert counters["serve.evictions"] == 1
+            assert counters.get("serve.retries", 0) >= 1
+            assert not reps[0].healthy
+        finally:
+            router.close()
+            fakes[1].close()
+
+    def test_no_healthy_replica_is_503(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, reps, _ = _mk_router(fakes, tmp_path)
+        try:
+            with router._lock:
+                for r in reps:
+                    r.healthy = False
+            status, body = _post(router.port, "/score", b"1 3:1\n")
+            assert status == 503
+            assert b"no healthy replica" in body
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
+class TestShedding:
+    def test_admit_sheds_past_the_deadline_budget(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, reps, _ = _mk_router(
+            fakes, tmp_path, serve_shed_deadline_ms=10.0
+        )
+        try:
+            # 6 in flight across 2 healthy replicas (>= the 2-per-
+            # replica floor) completing at ~100/s: projected delay
+            # 7/100 = 70 ms > 10 ms -> shed.
+            now = time.perf_counter()
+            with router._lock:
+                reps[0].inflight = 3
+                reps[1].inflight = 3
+                for i in range(100):
+                    router._completions.append(now - i * 0.01)
+            rep, why = router._admit()
+            assert rep is None and why == "shed"
+            # Below the concurrency floor admission always passes,
+            # whatever the rate says.
+            with router._lock:
+                reps[0].inflight = 1
+                reps[1].inflight = 1
+            rep, why = router._admit()
+            assert rep is not None and why is None
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_shed_is_fast_429_with_retry_after(self, tmp_path):
+        fakes = [FakeReplica(delay_s=0.3), FakeReplica(delay_s=0.3)]
+        router, _, tel = _mk_router(
+            fakes, tmp_path, serve_shed_deadline_ms=5.0
+        )
+        try:
+            results = []
+            lock = threading.Lock()
+
+            def client():
+                end = time.perf_counter() + 2.0
+                while time.perf_counter() < end:
+                    try:
+                        resp = urllib.request.urlopen(
+                            urllib.request.Request(
+                                f"http://127.0.0.1:{router.port}"
+                                "/score", data=b"1 3:1\n",
+                                method="POST",
+                            ), timeout=10,
+                        )
+                        resp.read()
+                        with lock:
+                            results.append((resp.status, None))
+                    except urllib.error.HTTPError as e:
+                        e.read()
+                        with lock:
+                            results.append(
+                                (e.code, e.headers.get("Retry-After"))
+                            )
+                        time.sleep(0.02)
+
+            threads = [
+                threading.Thread(target=client) for _ in range(10)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            codes = [c for c, _ in results]
+            assert codes.count(200) >= 1
+            assert codes.count(429) >= 1, (
+                "overload never shed — admission control is inert"
+            )
+            assert all(c in (200, 429) for c in codes)
+            retry_after = next(h for c, h in results if c == 429)
+            assert retry_after == "1"
+            blk = router._build()["serve"]
+            assert blk["shed"] == codes.count(429)
+            assert blk["shed_frac"] > 0
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_zero_deadline_disables_shedding(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, reps, _ = _mk_router(
+            fakes, tmp_path, serve_shed_deadline_ms=0.0
+        )
+        try:
+            now = time.perf_counter()
+            with router._lock:
+                reps[0].inflight = 50
+                reps[1].inflight = 50
+                for i in range(100):
+                    router._completions.append(now - i * 0.005)
+            rep, why = router._admit()
+            assert rep is not None and why is None
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
+class TestCanary:
+    def _canary_router(self, fakes, tmp_path, **cfg_kw):
+        model = tmp_path / "model"
+        model.mkdir(exist_ok=True)
+        defaults = dict(
+            serve_canary=True, serve_replicas=2, serve_poll_secs=0.05,
+            model_file=str(model),
+        )
+        defaults.update(cfg_kw)
+        router, reps, tel = _mk_router(fakes, tmp_path, **defaults)
+        return router, reps, tel, str(model)
+
+    def _publish(self, model, step):
+        checkpoint._publish_manifest(model, step, "dense")
+
+    def _traffic(self, port, n=6):
+        for _ in range(n):
+            status, _ = _post(port, "/score", b"1 3:1\n1 5:1\n")
+            assert status == 200
+
+    def test_promotion_rolls_the_fleet(self, tmp_path):
+        # The new checkpoint scores the SAME distribution: the shadow
+        # compare passes and every replica reloads + promotes.
+        fakes = [FakeReplica(score=0.5), FakeReplica(score=0.5)]
+        for f in fakes:
+            f.pending = (0.5000001, 7)  # new step, same distribution
+        router, reps, tel, model = self._canary_router(fakes, tmp_path)
+        try:
+            self._traffic(router.port)
+            self._publish(model, 7)
+            deadline = time.time() + 20
+            while router.step != 7 and time.time() < deadline:
+                time.sleep(0.05)
+            assert router.step == 7, "promotion never completed"
+            assert all(f.reload_calls == 1 for f in fakes)
+            assert all(f.promote_calls == 1 for f in fakes)
+            assert all(f.rollback_calls == 0 for f in fakes)
+            counters = tel.snapshot()["counters"]
+            assert counters["serve.canary_promotions"] == 1
+            assert counters.get("serve.canary_rollbacks", 0) == 0
+            # The compare artifacts are on disk for the operator.
+            compare_dir = tmp_path / "model" / "canary_compare" / \
+                "step_7"
+            assert (compare_dir / "baseline.json").exists()
+            assert (compare_dir / "canary.json").exists()
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_drifted_canary_rolls_back(self, tmp_path):
+        # The canary's post-reload scores drift far from the baseline
+        # replica's: report.py --compare flags, the canary rolls back,
+        # the rest of the fleet never reloads, and the bad manifest is
+        # baselined (no retry storm).
+        fakes = [FakeReplica(score=0.5), FakeReplica(score=0.5)]
+        fakes[0].pending = (0.9, 9)  # the canary would drift
+        fakes[1].pending = (0.9, 9)
+        router, reps, tel, model = self._canary_router(fakes, tmp_path)
+        try:
+            self._traffic(router.port)
+            self._publish(model, 9)
+            deadline = time.time() + 20
+            while fakes[0].rollback_calls == 0 and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            assert fakes[0].rollback_calls == 1, "canary never rolled back"
+            assert fakes[0].score == 0.5  # restored
+            assert fakes[1].reload_calls == 0  # fleet never touched
+            assert router.step != 9
+            counters = tel.snapshot()["counters"]
+            assert counters["serve.canary_rollbacks"] == 1
+            assert counters.get("serve.canary_promotions", 0) == 0
+            # Baselined: three more polls must not retry the reload.
+            calls = fakes[0].reload_calls
+            time.sleep(0.3)
+            assert fakes[0].reload_calls == calls
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_refused_reload_baselines_the_manifest(self, tmp_path):
+        fakes = [FakeReplica(score=0.5), FakeReplica(score=0.5)]
+        fakes[0].reload_status = 409  # unservable checkpoint
+        router, reps, tel, model = self._canary_router(fakes, tmp_path)
+        try:
+            self._publish(model, 11)
+            deadline = time.time() + 20
+            while fakes[0].reload_calls == 0 and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            assert fakes[0].reload_calls == 1
+            time.sleep(0.3)  # several polls
+            assert fakes[0].reload_calls == 1, (
+                "refused checkpoint retried every poll (the unbounded "
+                "reload loop the watcher baseline exists to prevent)"
+            )
+            assert router.step != 11
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
+    def test_failed_rollback_quarantines_until_next_promotion(
+        self, tmp_path
+    ):
+        """A rejected canary whose /rollback FAILS serves unvetted
+        params: it must be quarantined — alive is not enough for the
+        health loop to readmit it — until a later successful promotion
+        reloads it onto a vetted checkpoint."""
+        fakes = [FakeReplica(score=0.5) for _ in range(3)]
+        fakes[0].pending = (0.9, 13)      # the canary drifts...
+        fakes[0].rollback_status = 500    # ...and cannot roll back
+        router, reps, tel, model = self._canary_router(
+            fakes, tmp_path, serve_replicas=3, health_secs=0.05,
+        )
+        try:
+            self._traffic(router.port)
+            self._publish(model, 13)
+            deadline = time.time() + 20
+            while not reps[0].quarantined and time.time() < deadline:
+                time.sleep(0.05)
+            assert reps[0].quarantined, "failed rollback never quarantined"
+            assert not reps[0].healthy
+            # The replica still answers /healthz, but quarantine must
+            # hold it out of routing across many health cycles.
+            time.sleep(0.3)
+            assert not reps[0].healthy, (
+                "health loop readmitted a quarantined replica — it "
+                "would be serving the rejected table"
+            )
+            # A good checkpoint promotes through the remaining pair
+            # and recovers the quarantined replica onto it.
+            fakes[0].rollback_status = 200
+            for f in fakes:
+                f.pending = (0.5000001, 14)
+            self._traffic(router.port)
+            self._publish(model, 14)
+            deadline = time.time() + 20
+            while (
+                reps[0].quarantined or not reps[0].healthy
+            ) and time.time() < deadline:
+                time.sleep(0.05)
+            assert not reps[0].quarantined
+            assert reps[0].healthy, "recovered replica never readmitted"
+            assert fakes[0].score == pytest.approx(0.5000001)
+            assert router.step == 14
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
+class TestFleetLaunch:
+    def test_replica_command_neutralizes_fleet_knobs(self, tmp_path):
+        """ISSUE-12 review find: an INI-configured canary fleet used to
+        crash every child at startup — the replica re-read
+        serve_canary=true from the same cfg file while the launcher
+        forced --replicas 0, tripping the child's own
+        canary-requires-a-fleet validation.  The replica command must
+        neutralize every fleet-level knob, and the CHILD's config
+        parse (same cfg file + those flags) must succeed."""
+        from fast_tffm_tpu import cli
+        from fast_tffm_tpu.config import load_config
+        from fast_tffm_tpu.serve.router import _replica_command
+
+        cfg_path = tmp_path / "fleet.cfg"
+        cfg_path.write_text(
+            "[General]\nvocabulary_size = 64\nfactor_num = 4\n"
+            f"model_file = {tmp_path}/model\n"
+            "[Predict]\nserve_replicas = 2\nserve_canary = true\n"
+            "serve_poll_secs = 1.0\n"
+        )
+        cfg = load_config(str(cfg_path))
+        cmd = _replica_command(cfg, str(cfg_path), 0, {})
+        assert "--no_serve_canary" in cmd
+        assert cmd[cmd.index("--replicas") + 1] == "0"
+        assert cmd[cmd.index("--serve_poll_secs") + 1] == "0"
+        # Reproduce the child's own parse: argparse over the replica
+        # flags, then main()'s override assembly, then load_config.
+        args = cli.build_argparser().parse_args(cmd[3:])
+        overrides = {
+            key: getattr(args, key)
+            for key in ("serve_replicas", "serve_port", "serve_host",
+                        "serve_poll_secs")
+            if getattr(args, key) is not None
+        }
+        assert args.no_serve_canary
+        overrides["serve_canary"] = False
+        child = load_config(str(cfg_path), overrides)  # must not raise
+        assert child.serve_replicas == 0
+        assert child.serve_canary is False
+        assert child.serve_poll_secs == 0
+
+    def test_router_process_is_jax_free(self):
+        """The router front door must not pay a jax import (docstring +
+        SERVING.md pin it): wire/manifest/router import through lazy
+        package __init__s.  Probed in a clean subprocess — this test
+        process imported jax long ago."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__
+        )))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH",
+                                                        "")
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "import fast_tffm_tpu.serve.router\n"
+             "import fast_tffm_tpu.serve.wire\n"
+             "import fast_tffm_tpu.train.manifest\n"
+             "heavy = [m for m in ('jax', 'orbax', 'optax')\n"
+             "         if m in sys.modules]\n"
+             "assert not heavy, f'router import pulled {heavy}'\n"],
+            capture_output=True, timeout=120, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr.decode()
+
+
+class TestConfig:
+    def test_canary_requires_a_fleet(self):
+        with pytest.raises(ValueError, match="serve_replicas"):
+            FmConfig(serve_canary=True, serve_replicas=1)
+        with pytest.raises(ValueError, match="serve_poll_secs"):
+            FmConfig(serve_canary=True, serve_replicas=2,
+                     serve_poll_secs=0)
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError, match="serve_transport"):
+            FmConfig(serve_transport="grpc")
+        with pytest.raises(ValueError, match="serve_replicas"):
+            FmConfig(serve_replicas=-1)
+        with pytest.raises(ValueError, match="serve_shed_deadline_ms"):
+            FmConfig(serve_shed_deadline_ms=-1)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -39,6 +40,8 @@ from fast_tffm_tpu.serve.batcher import ServeBatcher
 from fast_tffm_tpu.serve.scorer import (
     FixedShapeScorer, OverlayScorer, load_model, make_scorer,
 )
+from fast_tffm_tpu.serve import wire
+from fast_tffm_tpu.serve.router import Replica, ServeRouter
 from fast_tffm_tpu.serve.server import (
     CheckpointWatcher, parse_request, serve,
 )
@@ -522,6 +525,224 @@ class TestEndToEnd:
             assert b"411" in status_line
         finally:
             handle.close()
+
+    def test_last_line_without_trailing_newline_is_scored(
+        self, trained
+    ):
+        """The framing contract (SERVING.md): one example per
+        non-blank LINE, and a final line without a trailing newline is
+        still a line — ISSUE 12 flagged this as a potential
+        silent-drop off-by-one, so it is pinned both at the parser and
+        over the socket."""
+        tmp_path, cfg = trained
+        with_nl = "1 5:0.5 9:0.25\n0 3:1\n"
+        without_nl = "1 5:0.5 9:0.25\n0 3:1"
+        ids_a, vals_a, _, na, _ = parse_request(with_nl, cfg)
+        ids_b, vals_b, _, nb, _ = parse_request(without_nl, cfg)
+        assert na == nb == 2, (
+            "a request whose last line lacks the trailing newline "
+            "dropped an example"
+        )
+        np.testing.assert_array_equal(ids_a, ids_b)
+        handle = serve(cfg, port=0)
+        try:
+            scores = []
+            for body in (with_nl, without_nl):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{handle.port}/score",
+                    data=body.encode(), method="POST",
+                )
+                scores.append(
+                    urllib.request.urlopen(req, timeout=30).read()
+                )
+            assert scores[0] == scores[1]
+            assert len(scores[0].splitlines()) == 2
+        finally:
+            handle.close()
+
+    def test_binary_transport_bitwise_equals_text(self, trained):
+        """/score_bin == /score bitwise for the same examples — both
+        directly and proxied through a router mounted over the live
+        replica — and the binary decode is accounted in its own
+        serve.parse_bin timer."""
+        tmp_path, cfg = trained
+        handle = serve(cfg, port=0)
+        router = None
+        try:
+            text = open(cfg.predict_files[0]).read()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{handle.port}/score",
+                data=text.encode(), method="POST",
+            )
+            text_scores = urllib.request.urlopen(
+                req, timeout=60
+            ).read().decode().splitlines()
+            ids, vals, fields, n, _ = parse_request(text, cfg)
+            frame = wire.encode_bin_request(ids, vals)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{handle.port}/score_bin",
+                data=frame, method="POST",
+            )
+            raw = urllib.request.urlopen(req, timeout=60).read()
+            bin_scores = [
+                f"{s:.6f}" for s in wire.decode_bin_response(raw)
+            ]
+            assert bin_scores == text_scores
+            # Through a router over this live replica: still bitwise.
+            router = ServeRouter(
+                0, [Replica(0, "127.0.0.1", handle.port)], cfg,
+            )
+            raw = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/score_bin",
+                data=frame, method="POST",
+            ), timeout=60).read()
+            routed_scores = [
+                f"{s:.6f}" for s in wire.decode_bin_response(raw)
+            ]
+            assert routed_scores == text_scores
+            blk = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{handle.port}/status", timeout=10
+            ).read())["serve"]
+            assert "parse_bin_p50_ms" in blk
+            assert "inflight" in blk
+        finally:
+            if router is not None:
+                router.close()
+            handle.close()
+
+    def test_transport_knob_gates_endpoints(self, trained):
+        import dataclasses
+
+        tmp_path, cfg = trained
+        handle = serve(
+            dataclasses.replace(cfg, serve_transport="text"), port=0
+        )
+        try:
+            frame = wire.encode_bin_request(
+                np.zeros((1, 4), np.int32), np.ones((1, 4), np.float32)
+            )
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{handle.port}/score_bin",
+                data=frame, method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc.value.code == 404
+            assert b"disabled" in exc.value.read()
+        finally:
+            handle.close()
+
+    def test_malformed_bin_frame_rejected(self, trained):
+        import struct
+
+        tmp_path, cfg = trained
+        handle = serve(cfg, port=0)
+        try:
+            for bad in (b"", b"XXXX" + b"\0" * 9,
+                        wire.encode_bin_request(
+                            np.zeros((2, 4), np.int32),
+                            np.ones((2, 4), np.float32),
+                        )[:-3],
+                        # n of billions over an f=0 header: the length
+                        # check must not be vacuous (a 13-byte body
+                        # must never reach an [n, F] allocation).
+                        struct.pack("<4sIIB", b"TFB1", 2**31, 0, 0)):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{handle.port}/score_bin",
+                    data=bad, method="POST",
+                )
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(req, timeout=30)
+                assert exc.value.code == 400
+                exc.value.read()
+        finally:
+            handle.close()
+
+    def test_admin_reload_promote_rollback(self, trained, rng):
+        """The canary swap surface on a REAL scorer: only
+        /reload?keep_prev=1 (the router's canary reload) retains the
+        replaced params for /rollback; a plain /reload leaves no
+        window (a stray admin call must neither pin a second table
+        nor make the model flippable), and /promote closes it."""
+        tmp_path, cfg = trained
+        fmt, step0, model = load_model(cfg)
+        handle = serve(cfg, port=0)
+        base = f"http://127.0.0.1:{handle.port}"
+        ids, vals = _examples(rng, 8)
+        try:
+            ref_old = handle.scorer.score(ids, vals)
+            new_params = _params(cfg, seed=21)
+            checkpoint.save(
+                cfg.model_file, step0 + 50,
+                fm.FmParams(*[np.asarray(x) for x in new_params]),
+            )
+            doc = json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/reload?keep_prev=1", data=b"",
+                    method="POST",
+                ), timeout=60,
+            ).read())
+            assert doc["step"] == step0 + 50
+            ref_new = handle.scorer.score(ids, vals)
+            assert not np.array_equal(ref_old, ref_new)
+            # A RETRIED keep_prev reload (a canary check that died
+            # between reload and verdict) must anchor, not clobber,
+            # the rollback target.
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/reload?keep_prev=1", data=b"", method="POST"
+            ), timeout=60).read()
+            # Rollback restores the exact ORIGINAL params.
+            doc = json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/rollback", data=b"", method="POST"
+                ), timeout=60,
+            ).read())
+            assert doc["step"] == step0
+            np.testing.assert_array_equal(
+                handle.scorer.score(ids, vals), ref_old
+            )
+            # A second rollback has nothing to restore -> 409.
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/rollback", data=b"", method="POST"
+                ), timeout=60)
+            assert exc.value.code == 409
+            exc.value.read()
+            # A PLAIN reload opens no window at all: rollback 409s
+            # and the new params stay.
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/reload", data=b"", method="POST"
+            ), timeout=60).read()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/rollback", data=b"", method="POST"
+                ), timeout=60)
+            assert exc.value.code == 409
+            exc.value.read()
+            np.testing.assert_array_equal(
+                handle.scorer.score(ids, vals), ref_new
+            )
+            # keep_prev reload + PROMOTE: the window closes again.
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/reload?keep_prev=1", data=b"", method="POST"
+            ), timeout=60).read()
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/promote", data=b"", method="POST"
+            ), timeout=60).read()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/rollback", data=b"", method="POST"
+                ), timeout=60)
+            assert exc.value.code == 409
+            exc.value.read()
+            assert handle.scorer.steady_compiles == 0
+        finally:
+            handle.close()
+            # Restore the original checkpoint for the other tests.
+            checkpoint.save(
+                cfg.model_file, step0,
+                fm.FmParams(*[np.asarray(x) for x in model]),
+            )
 
     def test_serve_stream_and_report_compat(self, trained, tmp_path):
         """A serve run's metrics stream carries the serve block;

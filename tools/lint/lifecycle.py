@@ -11,7 +11,13 @@ teardown on its owner's shutdown path:
   ``_ClosableQueue``              ``cancel`` / ``close``
   ``shared_memory.SharedMemory``  ``close`` / ``unlink``
   ``ThreadingHTTPServer``         ``shutdown``
+  ``subprocess.Popen``            ``wait`` / ``terminate`` / ``kill``
   ==============================  =========================
+
+``Popen`` (TL006) joined the table with the serve router's replica
+manager: a spawned replica subprocess with no reachable
+terminate/wait on the manager's teardown path would OUTLIVE its
+router — an orphaned jax process holding a port and a device.
 
 The class of leak this catches only shows at runtime today — the
 ``test_ingest_matrix`` /dev/shm sweep finds orphaned segments, and a
@@ -52,6 +58,7 @@ _RESOURCES = {
     "SharedMemory": ("TL003", "SHM segment", ("close", "unlink")),
     "ThreadingHTTPServer": ("TL004", "HTTP server", ("shutdown",)),
     "HTTPServer": ("TL004", "HTTP server", ("shutdown",)),
+    "Popen": ("TL006", "subprocess", ("wait", "terminate", "kill")),
 }
 
 
@@ -124,7 +131,7 @@ def _container_teardown(node, container, teardowns) -> bool:
 
 class LifecycleRule:
     name = "lifecycle"
-    rule_ids = ("TL001", "TL002", "TL003", "TL004", "TL005")
+    rule_ids = ("TL001", "TL002", "TL003", "TL004", "TL005", "TL006")
 
     def run(self, ctx: Context):
         findings = []

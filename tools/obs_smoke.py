@@ -27,6 +27,19 @@ checkpoint that run just wrote — ``run_tffm.py serve`` in a subprocess:
    checkpoint manifest, and the server HOT-SWAPS exactly as designed
    (``tffm_counter_serve_swaps_total`` reaches 1) while still scoring.
 
+Then the ROUTER smoke (scale-out serving, SERVING.md "Scale-out") —
+``run_tffm.py serve --replicas 2`` in a subprocess:
+
+9.  the router answers ``/score`` AND the binary ``/score_bin`` (a
+    hand-rolled frame pinning the documented wire layout) with
+    IDENTICAL scores for the same examples;
+10. SIGKILLing one replica mid-traffic loses no requests (transparent
+    retry) and the router's ``/metrics`` shows the eviction
+    (``tffm_counter_serve_evictions_total`` >= 1, the replica's
+    ``tffm_serve_replica_healthy`` series at 0);
+11. terminating the router tears down every replica subprocess — no
+    orphaned jax processes.
+
 Exit 0 = all held; any other exit fails the audit.
 """
 
@@ -312,6 +325,154 @@ def check_serve(cfg_path: str, data: str) -> None:
                 proc.wait()
 
 
+def check_router(cfg_path: str, data: str) -> None:
+    """Router smoke: 2 replicas behind the P2C router, text/binary
+    parity over the socket, a SIGKILL mid-traffic, and teardown with
+    no orphaned replica processes."""
+    import signal
+    import struct
+
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "run_tffm.py"), "serve",
+         cfg_path, "--replicas", "2", "--serve_port", str(port),
+         "--serve_poll_secs", "0.2"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    pids = []
+    try:
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 240
+        while True:
+            try:
+                urllib.request.urlopen(f"{base}/healthz", timeout=2)
+                break
+            except (urllib.error.URLError, OSError) as e:
+                if proc.poll() is not None:
+                    out, _ = proc.communicate()
+                    sys.stderr.write(out.decode(errors="replace")[-2000:])
+                    raise SystemExit(
+                        f"FAIL: router exited {proc.returncode} before "
+                        f"answering ({e})"
+                    )
+                if time.time() > deadline:
+                    raise SystemExit(
+                        f"FAIL: router endpoint unreachable ({e})"
+                    )
+                time.sleep(0.3)
+        status = json.loads(urllib.request.urlopen(
+            f"{base}/status", timeout=10).read())
+        per = status["serve"]["per_replica"]
+        if len(per) != 2 or any(p["pid"] is None for p in per):
+            raise SystemExit(
+                f"FAIL: /status per_replica malformed: {per}"
+            )
+        pids = [p["pid"] for p in per]
+        # Text/binary parity through the router, on a hand-rolled
+        # frame so the DOCUMENTED wire layout is what's pinned (not
+        # the package's own encoder): 2 examples x 3 features.
+        examples = [[(5, 0.5), (9, 0.25), (3, 1.0)],
+                    [(7, 0.125), (2, 0.75), (11, 1.0)]]
+        text = "".join(
+            "1 " + " ".join(f"{i}:{v}" for i, v in ex) + "\n"
+            for ex in examples
+        ).encode()
+        text_scores = urllib.request.urlopen(urllib.request.Request(
+            f"{base}/score", data=text, method="POST",
+        ), timeout=30).read().decode().split()
+        frame = struct.pack("<4sIIB", b"TFB1", 2, 3, 0)
+        frame += b"".join(
+            struct.pack("<i", i) for ex in examples for i, _ in ex
+        )
+        frame += b"".join(
+            struct.pack("<f", v) for ex in examples for _, v in ex
+        )
+        raw = urllib.request.urlopen(urllib.request.Request(
+            f"{base}/score_bin", data=frame, method="POST",
+        ), timeout=30).read()
+        magic, n = struct.unpack_from("<4sI", raw)
+        if magic != b"TFB1" or n != 2:
+            raise SystemExit(
+                f"FAIL: /score_bin response frame malformed "
+                f"({magic!r}, n={n})"
+            )
+        bin_scores = [
+            f"{s:.6f}" for s in struct.unpack_from("<2f", raw, 8)
+        ]
+        if bin_scores != text_scores:
+            raise SystemExit(
+                f"FAIL: binary scores {bin_scores} != text scores "
+                f"{text_scores} for the same examples"
+            )
+        # Kill one replica mid-traffic: every request must keep
+        # succeeding (the router retries in-flight requests on the
+        # survivor) and the eviction must show on /metrics.
+        os.kill(pids[0], signal.SIGKILL)
+        for i in range(20):
+            body = urllib.request.urlopen(urllib.request.Request(
+                f"{base}/score", data=text, method="POST",
+            ), timeout=30).read().decode()
+            if len(body.split()) != 2:
+                raise SystemExit(
+                    f"FAIL: request {i} after the SIGKILL answered "
+                    f"{body[:100]!r}"
+                )
+        deadline = time.time() + 30
+        while True:
+            metrics = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10).read().decode()
+            m = re.search(
+                r"^tffm_counter_serve_evictions_total (\d+)", metrics,
+                re.MULTILINE,
+            )
+            if m and int(m.group(1)) >= 1:
+                break
+            if time.time() > deadline:
+                raise SystemExit(
+                    "FAIL: router /metrics never showed the eviction"
+                )
+            time.sleep(0.3)
+        check_prometheus(metrics)
+        if not re.search(
+            r'^tffm_serve_replica_healthy\{replica="0"[^}]*\} 0',
+            metrics, re.MULTILINE,
+        ):
+            raise SystemExit(
+                "FAIL: killed replica not marked unhealthy in the "
+                "per-replica /metrics series"
+            )
+        print(
+            f"router smoke ok: 2 replicas, text==binary scores, "
+            f"20/20 requests after SIGKILL, eviction on /metrics"
+        )
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+    # The manager's teardown contract: no replica outlives its router.
+    deadline = time.time() + 10
+    for pid in pids[1:]:
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.2)
+            except ProcessLookupError:
+                break
+        else:
+            os.kill(pid, signal.SIGKILL)
+            raise SystemExit(
+                f"FAIL: replica pid {pid} outlived the router "
+                "(manager teardown leak)"
+            )
+    print("router teardown ok: no orphaned replica processes")
+
+
 def main() -> int:
     port = _free_port()
     tmpdir = tempfile.mkdtemp(prefix="tffm_obs_smoke_")
@@ -401,8 +562,10 @@ max_features = 4
             proc.kill()
             proc.wait()
     # The serve smoke scores against the checkpoint the run above just
-    # saved (run_tffm.py serve in its own subprocess).
+    # saved (run_tffm.py serve in its own subprocess), then the router
+    # smoke mounts a 2-replica fleet over the same checkpoint.
     check_serve(cfg_path, data)
+    check_router(cfg_path, data)
     return 0
 
 

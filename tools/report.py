@@ -822,6 +822,29 @@ _DIRECTION_OVERRIDES = {
     "quant_table_bytes_frac_int8": "low",
     "quant_step_rate_frac_bf16": "high",
     "quant_step_rate_frac_int8": "high",
+    # Scale-out serving (PR 12): router throughput regresses when it
+    # FALLS, router tail latency / shed fraction / binary-decode cost
+    # when they RISE (shed_frac is measured under the bench's fixed
+    # 4x-offered-load burst, so more shedding at the same offered load
+    # means less capacity).  The burst p99 is the ADMITTED-request
+    # tail under overload — the graceful-degradation number.
+    "serve_router_qps": "high", "serve_router_p99_ms": "low",
+    "serve_router_p50_ms": "low",
+    "serve_shed_frac": "low", "serve.shed_frac": "low",
+    "serve_burst_p99_ms": "low", "serve_burst_p99_x": "low",
+    "serve_bin_p50_ms": "low", "serve.parse_bin_p50_ms": "low",
+    "serve.shed": None, "serve.retries": None,
+    "serve.evictions": None, "serve.readmissions": None,
+    "serve.inflight": None,
+    "serve.canary_promotions": None, "serve.canary_rollbacks": None,
+    "serve.replicas": None, "serve.replicas_healthy": None,
+    # Canary shadow-score distribution keys (serve/router.py writes
+    # them as bench-style JSONs): the canary gate flags a DRIFT in
+    # EITHER direction — "both" is the two-sided direction compare_mode
+    # implements for exactly this.
+    "score_mean": "both", "score_std": "both",
+    "score_p10": "both", "score_p50": "both", "score_p90": "both",
+    "score_n": None,
     # Static-analysis cleanliness (PR 10): bench preflight runs
     # `python -m tools.lint` and records the NEW-finding count — a PR
     # that introduces one regresses the bench compare like any perf
@@ -985,6 +1008,13 @@ def compare_mode(path_a: str, path_b: str, thresholds: dict) -> int:
         if direction == "high" and ratio < 1 - threshold:
             flag = "REGRESSION"
         elif direction == "low" and ratio > 1 + threshold:
+            flag = "REGRESSION"
+        elif direction == "both" and not (
+            1 - threshold <= ratio <= 1 + threshold
+        ):
+            # Two-sided keys (canary score distributions): movement in
+            # EITHER direction is the regression — there is no
+            # "improved" side to a score drift.
             flag = "REGRESSION"
         elif direction == "high" and ratio > 1 + threshold:
             flag = "improved"
