@@ -980,6 +980,84 @@ def _bench_serve(workers: int) -> dict:
             )
         except Exception as e:  # noqa: BLE001 - probe must not sink it
             out["quant_probe_error"] = f"{type(e).__name__}: {e}"
+        # Paired serve-trace overhead probe (ISSUE 14): identical
+        # client windows against the SAME warm scorer — tracing OFF
+        # (the main stack, no tracer) vs request sampling at 0.1 with
+        # a live tracer — back-to-back so box drift can't masquerade
+        # as overhead.  serve_trace_overhead = qps_off / qps_on;
+        # budget <= 1.05, the standard obs-overhead budget.
+        try:
+            import dataclasses as _dc
+            import shutil as _sh2
+            import tempfile as _tf2
+
+            from fast_tffm_tpu.obs.trace import Tracer as _Tracer
+
+            def _probe_window(url_: str, dur: float):
+                done = [0]
+
+                def cl(seed: int):
+                    r = np.random.default_rng(seed)
+                    end = time.perf_counter() + dur
+                    while time.perf_counter() < end:
+                        body = bodies[int(r.integers(0, len(bodies)))]
+                        try:
+                            _rq.urlopen(_rq.Request(
+                                url_, data=body, method="POST"
+                            ), timeout=30).read()
+                        except Exception:  # noqa: BLE001 - end window
+                            return
+                        with lat_lock:
+                            done[0] += 1
+
+                ths = [
+                    _th.Thread(target=cl, args=(500 + i,))
+                    for i in range(n_clients)
+                ]
+                w0 = time.perf_counter()
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+                return done[0], time.perf_counter() - w0
+
+            trace_dir = _tf2.mkdtemp(prefix="tffm_bench_strace_")
+            t_cfg = _dc.replace(
+                cfg, serve_trace_sample=0.1,
+                trace_file=os.path.join(trace_dir, "serve_trace.json"),
+            )
+            t_tel = _obs.Telemetry()
+            tracer = _Tracer(enabled=True, process_name="serve-bench")
+            t_batcher = ServeBatcher(
+                scorer, max_batch_wait_ms=cfg.max_batch_wait_ms,
+                queue_size=cfg.queue_size, telemetry=t_tel,
+                tracer=tracer,
+            )
+            t_server = ServeServer(
+                0, t_batcher, t_cfg,
+                lambda: {"record": "status"}, telemetry=t_tel,
+                tracer=tracer,
+            )
+            try:
+                t_url = f"http://127.0.0.1:{t_server.port}/score"
+                _rq.urlopen(_rq.Request(
+                    t_url, data=bodies[0], method="POST"
+                ), timeout=60).read()
+                n_off, w_off = _probe_window(url, 2.0)
+                n_on, w_on = _probe_window(t_url, 2.0)
+                qps_off = n_off / w_off if w_off > 0 else 0.0
+                qps_on = n_on / w_on if w_on > 0 else 0.0
+                out["serve_trace_overhead"] = (
+                    round(qps_off / qps_on, 4) if qps_on > 0 else -1.0
+                )
+                out["serve_trace_dropped"] = int(tracer.dropped_events)
+            finally:
+                t_server.close()
+                t_batcher.close()
+                tracer.close()
+                _sh2.rmtree(trace_dir, ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 - probe must not sink it
+            out["trace_probe_error"] = f"{type(e).__name__}: {e}"
         out.update({
             "completed": True,
             "clients": n_clients,
@@ -1277,6 +1355,19 @@ max_features = 39
         router_block = handle.router._build()["serve"]
         out["router_evictions"] = router_block["evictions"]
         out["router_retries"] = router_block["retries"]
+        # Fleet metrics-scrape cost (ISSUE 14): one health-loop sweep
+        # pulling every replica's /status serve block — the price of
+        # one-scrape-sees-the-whole-fleet, kept visible so it can't
+        # silently grow with fleet size.
+        r_timers = handle.telemetry.snapshot().get("timers", {})
+        out["fleet_scrape_ms"] = float(
+            (r_timers.get("serve.fleet_scrape") or {}).get(
+                "p50_ms", 0.0
+            )
+        )
+        out["fleet_replicas_scraped"] = int(
+            router_block.get("replicas_scraped", 0)
+        )
         out["completed"] = True
     except Exception as e:  # noqa: BLE001 - report, never sink the bench
         out["error"] = f"{type(e).__name__}: {e}"

@@ -244,6 +244,25 @@ def build_argparser() -> argparse.ArgumentParser:
              "or both",
     )
     p.add_argument(
+        "--serve_trace_sample", type=float, default=None,
+        help="serve mode: trace this fraction of requests as a "
+             "connected cross-process span chain (request id minted "
+             "or from X-Request-Id, propagated router->replica and "
+             "echoed back; requires --trace; 0 = off)",
+    )
+    p.add_argument(
+        "--serve_slo_p99_ms", type=float, default=None,
+        help="serving SLO latency objective: a completed request "
+             "slower than this many ms counts against the error "
+             "budget (0 = latency not in the SLO)",
+    )
+    p.add_argument(
+        "--serve_slo_availability", type=float, default=None,
+        help="serving SLO availability objective (e.g. 0.999): "
+             "defines the error budget the rolling serve_burn_rate "
+             "gauge divides by (0 = no burn-rate accounting)",
+    )
+    p.add_argument(
         "--metrics_file", default=None, metavar="PATH",
         help="JSONL metrics stream path (overrides the cfg; a "
              "multi-replica fleet suffixes each replica's stream "
@@ -303,7 +322,9 @@ def main(argv=None) -> int:
                     "serve_batch_sizes", "max_batch_wait_ms",
                     "serve_poll_secs", "serve_replicas",
                     "serve_shed_deadline_ms", "serve_canary",
-                    "serve_transport", "metrics_file")
+                    "serve_transport", "serve_trace_sample",
+                    "serve_slo_p99_ms", "serve_slo_availability",
+                    "metrics_file")
         if getattr(args, key) is not None
     }
     if args.no_telemetry:

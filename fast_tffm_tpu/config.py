@@ -173,6 +173,28 @@ class FmConfig:
     # length-prefixed little-endian id/value/field arrays — skips text
     # parsing on the hot path entirely), or "both" (default).
     serve_transport: str = "both"
+    # Per-request distributed tracing sample rate for the serving path
+    # (0 = off, 1 = every request).  A sampled request gets a request
+    # id (client-supplied X-Request-Id or minted), the id propagates
+    # router -> replica (HTTP header for /score, the flags-gated frame
+    # trailer for /score_bin) and is echoed in the response header,
+    # and a connected span chain (admit -> proxy -> queue -> coalesce
+    # -> dispatch -> respond) lands in the trace files.  Requires
+    # trace_file (the spans need somewhere to go); the unsampled path
+    # is byte-identical to sampling off.  See OBSERVABILITY.md.
+    serve_trace_sample: float = 0.0
+    # Serving SLO: the latency objective in ms.  A completed request
+    # slower than this counts against the error budget (alongside
+    # sheds and 5xx responses).  0 = latency does not enter the SLO.
+    serve_slo_p99_ms: float = 0.0
+    # Serving SLO: the availability objective (e.g. 0.999).  Defines
+    # the error budget 1 - availability; the serving path computes the
+    # rolling burn rate bad_frac / budget over a sliding window and
+    # exposes it as the `serve.burn_rate` gauge + serve-block key (an
+    # alert signal: "burn_rate > 10 : warn").  0 = no burn-rate
+    # accounting (slo_bad_frac still reports when serve_slo_p99_ms is
+    # set).  See OBSERVABILITY.md "Serving SLO & burn rate".
+    serve_slo_availability: float = 0.0
 
     # --- observability (SURVEY.md §5: tracing/metrics rebuild) ---
     # Directory for a jax.profiler trace of steps
@@ -489,6 +511,30 @@ class FmConfig:
                 "serve_canary requires serve_replicas >= 2 (promotion "
                 "shadow-scores the canary against a baseline replica)"
             )
+        if not 0.0 <= self.serve_trace_sample <= 1.0:
+            raise ValueError(
+                "serve_trace_sample must be in [0, 1], got "
+                f"{self.serve_trace_sample}"
+            )
+        if self.serve_trace_sample > 0 and not self.trace_file:
+            # The silently-inert-knob discipline: a sampled request's
+            # span chain needs a trace file to land in; without one the
+            # knob could never do anything.
+            raise ValueError(
+                "serve_trace_sample > 0 requires trace_file (sampled "
+                "request chains are written to the trace output)"
+            )
+        if self.serve_slo_p99_ms < 0:
+            raise ValueError(
+                "serve_slo_p99_ms must be >= 0, got "
+                f"{self.serve_slo_p99_ms}"
+            )
+        if not 0.0 <= self.serve_slo_availability < 1.0:
+            raise ValueError(
+                "serve_slo_availability must be in [0, 1) — it is the "
+                "fraction of requests the SLO promises (0 = off), got "
+                f"{self.serve_slo_availability}"
+            )
         if self.serve_canary and self.serve_poll_secs <= 0:
             # Same hazard one knob over: the router's canary watcher
             # polls the manifest at serve_poll_secs, so 0 means no
@@ -635,6 +681,9 @@ _KEYMAP = {
     "serve_shed_deadline_ms": ("serve_shed_deadline_ms", float),
     "serve_canary": ("serve_canary", _parse_bool),
     "serve_transport": ("serve_transport", str),
+    "serve_trace_sample": ("serve_trace_sample", float),
+    "serve_slo_p99_ms": ("serve_slo_p99_ms", float),
+    "serve_slo_availability": ("serve_slo_availability", float),
     "profile_dir": ("profile_dir", str),
     "profile_start_step": ("profile_start_step", int),
     "profile_steps": ("profile_steps", int),

@@ -19,7 +19,8 @@ near-zero hot-path overhead, no jax or numpy imports).
 """
 
 from fast_tffm_tpu.obs.alerts import (
-    AlertEngine, AlertHaltError, AlertRule, parse_rules,
+    AlertEngine, AlertHaltError, AlertRule, halt_error,
+    parse_rules, run_until_halt,
 )
 from fast_tffm_tpu.obs.heartbeat import Heartbeat, JsonlWriter
 from fast_tffm_tpu.obs.resource import CompileSentinel, read_rss
@@ -33,6 +34,7 @@ __all__ = [
     "Counter", "Gauge", "Timing", "DepthHist", "Telemetry", "NULL",
     "trace_span", "Heartbeat", "JsonlWriter", "Tracer", "NULL_TRACER",
     "StatusServer", "render_prometheus",
-    "AlertEngine", "AlertHaltError", "AlertRule", "parse_rules",
+    "AlertEngine", "AlertHaltError", "AlertRule", "halt_error",
+    "parse_rules", "run_until_halt",
     "CompileSentinel", "read_rss",
 ]

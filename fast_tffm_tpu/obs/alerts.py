@@ -68,12 +68,14 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 from collections import deque
 from typing import Callable, List, Optional
 
 __all__ = [
-    "AlertRule", "AlertEngine", "AlertHaltError", "parse_rules",
+    "AlertRule", "AlertEngine", "AlertHaltError", "halt_error",
+    "parse_rules", "run_until_halt",
     "BASELINE_WINDOW", "BASELINE_MIN",
 ]
 
@@ -101,6 +103,17 @@ _ALIASES = {
     "peak_rss_mb": "resource.peak_rss_mb",
     "rss_mb": "resource.rss_mb",
     "compile_s": "resource.compile_s",
+    # Serving plane (the `serve` block a serve/router heartbeat
+    # carries): the SLO burn rate, the router's shed fraction and
+    # eviction count, and fleet-scrape staleness — the one-line-rule
+    # signals a serving operator pages on (OBSERVABILITY.md "Serving
+    # SLO & burn rate").
+    "burn_rate": "serve.burn_rate",
+    "slo_bad_frac": "serve.slo_bad_frac",
+    "shed_frac": "serve.shed_frac",
+    "evictions": "serve.evictions",
+    "respawns": "serve.respawns",
+    "fleet_scrape_age_max_s": "serve.fleet_scrape_age_max_s",
 }
 
 
@@ -118,6 +131,33 @@ class AlertHaltError(RuntimeError):
     Training stops without overwriting the checkpoint; the final
     metrics record carries this exception type (same crash-truthful
     contract as ``nan_policy=halt``)."""
+
+
+def halt_error(alert: dict) -> AlertHaltError:
+    """The one spelling of a halt alert's exception message — the
+    training dispatch loop and both serving watch loops raise it, so
+    the format can't drift between them."""
+    return AlertHaltError(
+        f"alert rule {alert['rule']} fired with action=halt"
+        + (f" at step {alert['step']}"
+           if alert.get("step") is not None else "")
+        + f": {alert['signal']}={alert['value']} {alert['op']} "
+          f"{alert['threshold']} (sustained {alert['sustain']} "
+          "heartbeat(s))"
+    )
+
+
+def run_until_halt(engine: Optional["AlertEngine"],
+                   poll_s: float = 1.0) -> None:
+    """Block the calling (main) thread until an ``action: halt`` rule
+    fires — then raise :class:`AlertHaltError` — or forever.  The
+    serving entrypoints' watch loop: with no engine there is nothing
+    to poll and the wait is the historical zero-wake block
+    (interrupted only by KeyboardInterrupt / a signal handler)."""
+    stop = threading.Event()
+    while not stop.wait(poll_s if engine is not None else None):
+        if engine is not None and engine.halted is not None:
+            raise halt_error(engine.halted)
 
 
 @dataclasses.dataclass(frozen=True)

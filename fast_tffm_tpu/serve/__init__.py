@@ -19,6 +19,7 @@ save-path manifest.  See SERVING.md for the dataflow.
 # when one of their names is actually touched.
 _EXPORTS = {
     "ServeBatcher": "fast_tffm_tpu.serve.batcher",
+    "SloTracker": "fast_tffm_tpu.serve.slo",
     "FixedShapeScorer": "fast_tffm_tpu.serve.scorer",
     "OverlayScorer": "fast_tffm_tpu.serve.scorer",
     "load_model": "fast_tffm_tpu.serve.scorer",

@@ -25,6 +25,16 @@ Instruments (all ``serve.*``, documented in OBSERVABILITY.md):
 timer (enqueue -> scores delivered; p50/p95/p99 ride every snapshot),
 the ``batch_fill`` gauge (cumulative filled/dispatched slots), and the
 ``queue_depth`` histogram.
+
+Distributed tracing: a request carrying a request id (``rid``, from
+the ``X-Request-Id`` header or the binary frame's trailer on a SAMPLED
+request) gets per-request spans — ``serve.queue_wait`` (enqueue ->
+picked by the dispatcher), ``serve.coalesce`` (picked -> the
+microbatch dispatches) and ``serve.dispatch`` (the rung dispatch, with
+a flow step on the rid) — emitted from recorded timestamps AFTER the
+dispatch, so the hot path pays nothing but two ``perf_counter`` reads.
+A rid-less request touches none of it (the unsampled path is the
+pre-trace code path).
 """
 
 from __future__ import annotations
@@ -40,6 +50,7 @@ from fast_tffm_tpu import obs
 from fast_tffm_tpu.data.pipeline import (
     _CANCELLED, _TIMEOUT, _ClosableQueue,
 )
+from fast_tffm_tpu.obs.trace import NULL_TRACER
 
 log = logging.getLogger(__name__)
 
@@ -47,12 +58,17 @@ __all__ = ["ScoreRequest", "ServeBatcher"]
 
 
 class ScoreRequest:
-    """One in-flight scoring request (a future the client waits on)."""
+    """One in-flight scoring request (a future the client waits on).
+
+    ``rid`` is the distributed-trace request id (None = unsampled);
+    ``t_picked`` is stamped by the dispatcher when the request leaves
+    the queue, only for rid-carrying requests (span reconstruction
+    needs it; the unsampled path skips the write)."""
 
     __slots__ = ("ids", "vals", "fields", "n", "event", "scores",
-                 "error", "t0")
+                 "error", "t0", "rid", "t_picked")
 
-    def __init__(self, ids, vals, fields):
+    def __init__(self, ids, vals, fields, rid=None):
         self.ids = ids
         self.vals = vals
         self.fields = fields
@@ -61,15 +77,20 @@ class ScoreRequest:
         self.scores: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.t0 = time.perf_counter()
+        self.rid = rid
+        self.t_picked: Optional[float] = None
 
 
 class ServeBatcher:
     """Coalesce requests into microbatches under a latency deadline."""
 
     def __init__(self, scorer, max_batch_wait_ms: float = 2.0,
-                 queue_size: int = 1024, telemetry=None):
+                 queue_size: int = 1024, telemetry=None, tracer=None,
+                 slo=None):
         self._scorer = scorer
         self._wait_s = max(0.0, float(max_batch_wait_ms)) / 1e3
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        self._slo = slo
         tel = telemetry if telemetry is not None else obs.NULL
         self._c_requests = tel.counter("serve.requests")
         self._c_examples = tel.counter("serve.examples")
@@ -105,7 +126,7 @@ class ServeBatcher:
 
     # -- client side ---------------------------------------------------
 
-    def submit(self, ids, vals, fields=None) -> ScoreRequest:
+    def submit(self, ids, vals, fields=None, rid=None) -> ScoreRequest:
         """Enqueue ``[n, max_features]`` arrays; returns the request
         future.  Raises RuntimeError once the batcher is closed."""
         req = ScoreRequest(
@@ -113,6 +134,7 @@ class ServeBatcher:
             np.ascontiguousarray(vals, np.float32),
             (np.ascontiguousarray(fields, np.int32)
              if fields is not None else None),
+            rid=rid,
         )
         with self._out_lock:
             if self._closed:
@@ -145,10 +167,12 @@ class ServeBatcher:
             raise req.error
         return req.scores
 
-    def score(self, ids, vals, fields=None,
-              timeout: float = 30.0) -> np.ndarray:
+    def score(self, ids, vals, fields=None, timeout: float = 30.0,
+              rid=None) -> np.ndarray:
         """submit + result in one call (the HTTP handler's path)."""
-        return self.result(self.submit(ids, vals, fields), timeout)
+        return self.result(
+            self.submit(ids, vals, fields, rid=rid), timeout
+        )
 
     @property
     def batch_fill(self) -> float:
@@ -176,6 +200,8 @@ class ServeBatcher:
             pending = None
             if first is _CANCELLED:
                 break
+            if first.rid is not None and first.t_picked is None:
+                first.t_picked = time.perf_counter()
             group = [first]
             total = first.n
             deadline = time.monotonic() + self._wait_s
@@ -188,6 +214,8 @@ class ServeBatcher:
                     break
                 if nxt is _CANCELLED:
                     break
+                if nxt.rid is not None:
+                    nxt.t_picked = time.perf_counter()
                 if total + nxt.n > max_b:
                     # Doesn't fit this rung: dispatch what we have and
                     # seed the next microbatch (keeps every coalesced
@@ -201,8 +229,31 @@ class ServeBatcher:
         # the cancel discarded AND a pending carry-over).
         self._fail_outstanding(RuntimeError("ServeBatcher closed"))
 
+    def _trace_request(self, g: ScoreRequest, t_d0: float,
+                       t_d1: float, rung: int, total: int) -> None:
+        """Emit one sampled request's replica-side spans from the
+        recorded timestamps (queue wait -> coalesce -> dispatch).  The
+        flow step on the rid links the chain to the router's proxy
+        span and the handler's respond span."""
+        args = {"rid": g.rid}
+        picked = g.t_picked if g.t_picked is not None else t_d0
+        self._tracer.emit(
+            "serve.queue_wait", g.t0, picked - g.t0, args=args,
+        )
+        self._tracer.emit(
+            "serve.coalesce", picked, t_d0 - picked,
+            args={"rid": g.rid, "group_n": total},
+        )
+        self._tracer.emit(
+            "serve.dispatch", t_d0, t_d1 - t_d0,
+            args={"rid": g.rid, "rung": rung, "n": total},
+            flow=("t", g.rid),
+        )
+
     def _dispatch(self, group, total: int) -> None:
         scorer = self._scorer
+        rung = 0
+        t_d0 = time.perf_counter()
         try:
             if len(group) == 1 and total > scorer.max_rung:
                 # One oversized request: the scorer chunks it itself
@@ -210,8 +261,9 @@ class ServeBatcher:
                 req = group[0]
                 scores = scorer.score(req.ids, req.vals, req.fields)
                 self._slots += scorer.slots_for(total)
+                rung = scorer.max_rung
             else:
-                b = scorer.rung_for(total)
+                rung = b = scorer.rung_for(total)
                 bi, bv, bf = self._pool(b)
                 pos = 0
                 any_fields = any(g.fields is not None for g in group)
@@ -242,6 +294,10 @@ class ServeBatcher:
                 g.scores = np.asarray(scores[pos:pos + g.n], np.float32)
                 pos += g.n
                 self._t_latency.observe(now - g.t0)
+                if self._slo is not None:
+                    self._slo.observe(True, now - g.t0)
+                if g.rid is not None:
+                    self._trace_request(g, t_d0, now, rung, total)
                 with self._out_lock:
                     self._outstanding.discard(g)
                     self._g_inflight.set(len(self._outstanding))
@@ -250,6 +306,8 @@ class ServeBatcher:
             log.warning("serve dispatch failed: %s", e)
             for g in group:
                 g.error = e
+                if self._slo is not None:
+                    self._slo.observe(False)
                 with self._out_lock:
                     self._outstanding.discard(g)
                     self._g_inflight.set(len(self._outstanding))

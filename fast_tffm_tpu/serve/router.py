@@ -65,7 +65,9 @@ from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.obs.status import (
     ObsHTTPServer, QuietHandler, render_prometheus,
 )
+from fast_tffm_tpu.obs.trace import NULL_TRACER, Tracer
 from fast_tffm_tpu.serve import wire
+from fast_tffm_tpu.serve.slo import SloTracker
 from fast_tffm_tpu.train import manifest
 
 log = logging.getLogger(__name__)
@@ -103,7 +105,8 @@ class Replica:
     """
 
     __slots__ = ("index", "host", "port", "proc", "inflight", "routed",
-                 "healthy", "fails", "quarantined")
+                 "healthy", "fails", "quarantined", "respawn_fails",
+                 "respawn_pending", "next_respawn_t")
 
     def __init__(self, index: int, host: str, port: int, proc=None):
         self.index = index
@@ -115,6 +118,12 @@ class Replica:
         self.healthy = True
         self.fails = 0
         self.quarantined = False
+        # Respawn state (health-loop thread only): the in-flight
+        # _ReplicaProc of a relaunch, consecutive failed relaunches,
+        # and the earliest monotonic time the next attempt may start.
+        self.respawn_fails = 0
+        self.respawn_pending = None
+        self.next_respawn_t = 0.0
 
     @property
     def pid(self) -> Optional[int]:
@@ -173,11 +182,26 @@ class _ReplicaProc:
 
 
 # CLI overrides the fleet launcher consumes itself (or forces per
-# replica) rather than passing through.
+# replica) rather than passing through.  trace_file and
+# serve_trace_sample are fleet-level: the launcher re-renders the
+# trace path with a per-replica suffix (N replicas dumping to ONE
+# path would clobber each other) and pins replica self-sampling OFF —
+# the ROUTER is the fleet's front door and owns the sampling decision;
+# a replica that also sampled its own proxied traffic would mint
+# partial chains with no router half.
 _NO_PASSTHROUGH = {
     "serve_replicas", "serve_port", "serve_host", "serve_canary",
-    "serve_poll_secs", "metrics_file",
+    "serve_poll_secs", "metrics_file", "trace_file",
+    "serve_trace_sample", "alert_rules",
 }
+
+# Respawn backoff (ROADMAP direction-3 leftover): a died MANAGED
+# replica relaunches after min(_RESPAWN_CAP_S, _RESPAWN_BASE_S * 2^k)
+# where k counts consecutive failed relaunches (a replica that dies
+# before announcing its port).  The first death respawns immediately;
+# a crash-looping one backs off to the cap.
+_RESPAWN_BASE_S = 1.0
+_RESPAWN_CAP_S = 30.0
 
 
 def _passthrough_flags(overrides: Optional[dict]) -> list:
@@ -223,11 +247,27 @@ def _replica_command(cfg: FmConfig, cfg_path: str, index: int,
         # their usual poll cadence.
         "--serve_poll_secs",
         "0" if cfg.serve_canary else str(cfg.serve_poll_secs),
+        # The router owns trace sampling (it mints the ids and stamps
+        # them onto proxied requests); a replica that also sampled its
+        # own traffic would emit router-less partial chains.  Forced
+        # here so an INI-configured serve_trace_sample can't leak into
+        # the children (same neutralization as --no_serve_canary).
+        "--serve_trace_sample", "0",
+        # The router owns the alert watchdog too: fleet rules (burn
+        # rate, shed fraction, staleness) evaluate against ROUTER
+        # heartbeats.  A replica re-reading the same rules would
+        # self-halt on an action=halt breach — and the respawn policy
+        # would relaunch it into an endless warm-up/halt/respawn loop.
+        "--alert_rules", "",
     ]
     if cfg.metrics_file:
         # One JSONL stream per process: N replicas appending to the
         # router's configured path would interleave into garbage.
         cmd += ["--metrics_file", f"{cfg.metrics_file}.replica{index}"]
+    if cfg.trace_file:
+        # Same one-file-per-process rule for traces; report.py
+        # --serve-trace merges the family back onto one timeline.
+        cmd += ["--trace", f"{cfg.trace_file}.replica{index}"]
     return cmd + _passthrough_flags(overrides)
 
 
@@ -258,6 +298,12 @@ class ReplicaManager:
             os.path.dirname(os.path.abspath(__file__))
         ))
         env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        self._cfg = cfg
+        self._cfg_path = cfg_path
+        self._overrides = overrides
+        self._env = env
+        self._lock = threading.Lock()
+        self._closed = False
         self._procs: list = []
         self.replicas: list = []
         try:
@@ -285,14 +331,43 @@ class ReplicaManager:
             self.close()
             raise
 
+    def respawn(self, index: int):
+        """Relaunch replica ``index``'s subprocess (the respawn policy,
+        ROADMAP direction-3 leftover).  The dead predecessor is reaped
+        first; the fresh :class:`_ReplicaProc` is adopted into
+        ``_procs`` immediately (the manager owns every child it ever
+        spawned — lint rule TL006's reachable-teardown invariant) and
+        returned NON-blocking: the router's health loop polls its
+        ``ready``/``port`` and re-points the :class:`Replica` at the
+        announced port.  Returns None once the manager is closed (a
+        teardown racing a death must not spawn an orphan)."""
+        with self._lock:
+            if self._closed:
+                return None
+            old = self._procs[index]
+            try:
+                old.close(grace_s=0.0)  # already dead: reap + join
+            except Exception as e:  # noqa: BLE001 - reap best-effort
+                log.warning("replica %d reap failed: %s", index, e)
+            cmd = _replica_command(
+                self._cfg, self._cfg_path, index, self._overrides
+            )
+            fresh = _ReplicaProc(index, cmd, self._env)
+            self._procs[index] = fresh
+        log.info("respawning replica %d (pid %d)", index,
+                 fresh.proc.pid)
+        return fresh
+
     def close(self) -> None:
-        for rp in self._procs:
+        with self._lock:
+            self._closed = True
+            procs, self._procs = self._procs, []
+        for rp in procs:
             try:
                 rp.close()
             except Exception as e:  # noqa: BLE001 - teardown best-effort
                 log.warning("replica %d teardown failed: %s",
                             rp.index, e)
-        self._procs = []
 
 
 class _ProxyError(Exception):
@@ -308,7 +383,8 @@ class ServeRouter:
                  telemetry=None, writer=None, host: str = "127.0.0.1",
                  health_secs: float = 0.5,
                  manifest_seen: Optional[dict] = None,
-                 proxy_timeout_s: float = 30.0):
+                 proxy_timeout_s: float = 30.0, tracer=None,
+                 sampler=None, respawner=None):
         self.cfg = cfg
         tel = telemetry if telemetry is not None else obs.NULL
         self._tel = tel
@@ -319,20 +395,49 @@ class ServeRouter:
         self._c_retries = tel.counter("serve.retries")
         self._c_promotions = tel.counter("serve.canary_promotions")
         self._c_rollbacks = tel.counter("serve.canary_rollbacks")
+        self._c_respawns = tel.counter("serve.respawns")
+        self._c_scrape_errors = tel.counter("serve.scrape_errors")
         self._g_inflight = tel.gauge("serve.inflight")
         self._t_proxy = tel.timer("serve.proxy")
+        self._t_scrape = tel.timer("serve.fleet_scrape")
         self._writer = writer
         self._replicas = list(replicas)
         self._lock = threading.Lock()
         self._rng = random.Random(0xF00D)
         self._deadline_s = cfg.serve_shed_deadline_ms / 1e3
         self._proxy_timeout_s = proxy_timeout_s
+        # Distributed tracing: the router is the fleet's front door,
+        # so it owns the sampling decision and the request-id mint;
+        # tracer disabled (no trace_file) = the shared no-op.
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        self._sampler = sampler if sampler is not None else (
+            wire.RequestSampler(
+                cfg.serve_trace_sample, enabled=self._tracer.enabled,
+                tag="rt",
+            )
+        )
+        # SLO ledger: every front-door outcome (admitted latency +
+        # status, sheds, no-replica 503s) -> rolling burn rate.
+        self._slo = SloTracker(
+            cfg.serve_slo_p99_ms, cfg.serve_slo_availability,
+            telemetry=tel,
+        )
+        # Respawn policy: relaunch died MANAGED replicas (callable
+        # index -> _ReplicaProc-shaped handle, normally
+        # ReplicaManager.respawn).  None = the historical evict-only
+        # behavior (unmanaged host:port replicas always are).
+        self._respawner = respawner
         # Completion timestamps inside a sliding window: the measured
         # service rate the admission budget divides by (Little's law).
         self._rate_window_s = 1.0
         self._completions: collections.deque = collections.deque()
         # Idle kept-alive connections per replica index.
         self._conns: dict = {r.index: [] for r in self._replicas}
+        # Latest per-replica /status scrape: index -> (wall time,
+        # serve block dict).  The health loop doubles as the fleet
+        # metrics scraper; /metrics re-exposes these as fleet
+        # aggregates + per-replica labeled series.
+        self._scrapes: dict = {}
         # Recent request bodies, the canary shadow-scoring sample.
         self._sample: collections.deque = collections.deque(maxlen=32)
         self._health_secs = max(0.05, float(health_secs))
@@ -366,9 +471,22 @@ class ServeRouter:
                     "text/plain" if want == "text"
                     else "application/octet-stream",
                 )
+                # Request id: client-supplied X-Request-Id always
+                # propagates and echoes; otherwise the sampling coin
+                # flip decides whether to mint one.  An unsampled
+                # id-less request does NO id work and proxies
+                # byte-identical bodies (pinned by test).
+                rid = self.headers.get("X-Request-Id")
+                if rid is not None and not wire.valid_request_id(rid):
+                    rid = None
+                if rid is None and router._sampler.sample():
+                    rid = router._sampler.mint()
                 status, data, rctype, headers = router._handle(
-                    path, body, ctype
+                    path, body, ctype, rid=rid
                 )
+                if rid is not None:
+                    headers = dict(headers or {})
+                    headers["X-Request-Id"] = rid
                 # The body was fully consumed above, so even an error
                 # status is keep-alive-safe — and a shedding router
                 # MUST keep connections open (closing them turns every
@@ -481,11 +599,29 @@ class ServeRouter:
                 sum(r.inflight for r in self._replicas)
             )
 
-    def _handle(self, path: str, body: bytes, ctype: str):
+    def _handle(self, path: str, body: bytes, ctype: str, rid=None):
         """Route one scoring request; returns (status, body, ctype,
-        headers-or-None) for the front handler to send."""
+        headers-or-None) for the front handler to send.  ``rid`` (a
+        sampled or client-supplied request id) propagates to the
+        replica and opens the request's router-side span chain."""
+        t_admit = time.perf_counter()
         rep, why = self._admit()
+        traced = rid is not None and self._tracer.enabled
+        if traced:
+            # The admit/shed decision: tiny, but it is where a shed
+            # request's chain ENDS — an operator tracing a 429 sees
+            # the decision, not silence.
+            self._tracer.emit(
+                "serve.admit", t_admit,
+                time.perf_counter() - t_admit,
+                args={
+                    "rid": rid,
+                    "decision": why or "admit",
+                    "replica": rep.index if rep is not None else -1,
+                },
+            )
         if rep is None:
+            self._slo.observe(False)
             if why == "shed":
                 self._c_shed.add()
                 return (
@@ -499,7 +635,7 @@ class ServeRouter:
         while True:
             try:
                 status, data, rctype = self._forward(
-                    rep, path, body, ctype
+                    rep, path, body, ctype, rid=rid, traced=traced,
                 )
                 break
             except _ProxyError as e:
@@ -512,11 +648,25 @@ class ServeRouter:
                 self._c_retries.add()
                 rep = self._pick_retry(exclude=rep)
                 if rep is None:
+                    self._slo.observe(False)
                     return (503, b"no healthy replica\n", "text/plain",
                             None)
         self._dec(rep)
         now = time.perf_counter()
         self._t_proxy.observe(now - t0)
+        # SLO verdict: admitted and answered below 500 is transport-ok
+        # (a 4xx is the client's malformed request, not lost
+        # availability); the latency objective can still demote it.
+        self._slo.observe(status < 500, now - t0)
+        if traced:
+            # The proxy span opens the cross-process flow ("s"): the
+            # replica's serve.dispatch steps it, serve.respond ends it.
+            self._tracer.emit(
+                "serve.proxy", t0, now - t0,
+                args={"rid": rid, "replica": rep.index,
+                      "status": status},
+                flow=("s", rid),
+            )
         with self._lock:
             self._completions.append(now)
         if (
@@ -550,11 +700,22 @@ class ServeRouter:
         conn.close()
 
     def _forward(self, rep: Replica, path: str, body: bytes,
-                 ctype: str):
+                 ctype: str, rid=None, traced: bool = False):
         """One proxied POST.  A failure on a REUSED connection retries
         once on a fresh one (an idle kept-alive socket the replica
         timed out is stale, not a dead replica); a fresh-connection
-        failure raises _ProxyError."""
+        failure raises _ProxyError.
+
+        ``rid`` propagates to the replica as the ``X-Request-Id``
+        header; a TRACED ``/score_bin`` request additionally carries it
+        as the frame's flags-bit-1 trailer (the binary transport's
+        documented spelling) — an untraced frame proxies byte-identical
+        to what the client sent."""
+        headers = {"Content-Type": ctype}
+        if rid is not None:
+            headers["X-Request-Id"] = rid
+            if traced and path == "/score_bin":
+                body = wire.with_bin_request_id(body, rid)
         for attempt in (0, 1):
             conn, reused = self._conn_acquire(rep)
             if attempt and reused:
@@ -565,8 +726,7 @@ class ServeRouter:
                 ), False
             try:
                 conn.request(
-                    "POST", path, body=body,
-                    headers={"Content-Type": ctype},
+                    "POST", path, body=body, headers=headers,
                 )
                 resp = conn.getresponse()
                 data = resp.read()
@@ -614,6 +774,9 @@ class ServeRouter:
                 return
             rep.healthy = True
             rep.fails = 0
+            # Back in service resets the respawn backoff: the next
+            # death is a fresh incident, not attempt k+1 of this one.
+            rep.respawn_fails = 0
         self._c_readmissions.add()
         log.info("replica %d (%s) readmitted to routing",
                  rep.index, rep.address)
@@ -632,10 +795,13 @@ class ServeRouter:
             for rep in self._replicas:
                 if self._stop.is_set():
                     return
+                if rep.respawn_pending is not None:
+                    self._respawn_poll(rep)
                 if rep.proc is not None and rep.proc.poll() is not None:
                     self._evict(
                         rep, f"process exited {rep.proc.poll()}"
                     )
+                    self._respawn_step(rep)
                     continue
                 if self._probe_health(rep):
                     with self._lock:
@@ -651,6 +817,100 @@ class ServeRouter:
                             f"{rep.fails} consecutive /healthz "
                             "failures",
                         )
+            self._scrape_fleet()
+
+    # -- respawn policy ----------------------------------------------------
+
+    def _respawn_step(self, rep: Replica) -> None:
+        """Relaunch a died MANAGED replica (health-loop thread).  The
+        launch is non-blocking — _respawn_poll watches the fresh
+        process's port announcement over subsequent ticks — and each
+        attempt backs off exponentially (capped) until a readmission
+        resets the counter.  Unmanaged host:port replicas (proc None)
+        and routers without a respawner keep the historical evict-only
+        behavior."""
+        if (
+            self._respawner is None or rep.proc is None
+            or rep.respawn_pending is not None
+        ):
+            return
+        now = time.monotonic()
+        if now < rep.next_respawn_t:
+            return
+        rep.next_respawn_t = now + min(
+            _RESPAWN_CAP_S, _RESPAWN_BASE_S * (2 ** rep.respawn_fails)
+        )
+        rep.respawn_fails += 1
+        try:
+            pending = self._respawner(rep.index)
+        except Exception as e:  # noqa: BLE001 - retry at the backoff
+            log.warning("replica %d respawn launch failed: %s",
+                        rep.index, e)
+            return
+        if pending is None:  # manager closing; no orphan spawned
+            return
+        rep.respawn_pending = pending
+        self._c_respawns.add()
+
+    def _respawn_poll(self, rep: Replica) -> None:
+        """Adopt a pending respawn once its port is announced (the
+        replica prints it only after the ladder is warm, so an adopted
+        replica is a WARM replica); a relaunch that died without
+        announcing counts against the backoff and retries."""
+        pending = rep.respawn_pending
+        if not pending.ready.is_set():
+            return
+        rep.respawn_pending = None
+        if pending.port is None:
+            log.warning(
+                "respawned replica %d died before announcing a port "
+                "(exit %s); next attempt in %.0fs",
+                rep.index, pending.proc.poll(),
+                max(0.0, rep.next_respawn_t - time.monotonic()),
+            )
+            return
+        with self._lock:
+            rep.port = pending.port
+            rep.proc = pending.proc
+            # Any pooled connection still points at the OLD port.
+            stale = self._conns.get(rep.index) or []
+            self._conns[rep.index] = []
+        for conn in stale:
+            conn.close()
+        log.info(
+            "replica %d respawned on %s (pid %s); awaiting the health "
+            "loop's readmission", rep.index, rep.address, rep.pid,
+        )
+
+    # -- fleet metrics scrape ----------------------------------------------
+
+    def _scrape_fleet(self) -> None:
+        """Pull each healthy replica's /status serve block (the health
+        loop doubles as the fleet metrics scraper).  Results feed the
+        fleet aggregates + per-replica labeled series on the router's
+        /metrics; a failed scrape keeps the previous block and lets its
+        staleness age (``fleet_scrape_age_max_s`` is the alert
+        signal)."""
+        with self._t_scrape.time():
+            for rep in self._replicas:
+                if self._stop.is_set():
+                    return
+                with self._lock:
+                    healthy = rep.healthy
+                if not healthy:
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{rep.address}/status", timeout=2.0
+                    ) as resp:
+                        doc = json.loads(resp.read())
+                except (urllib.error.URLError, OSError, ValueError):
+                    self._c_scrape_errors.add()
+                    continue
+                block = doc.get("serve")
+                if isinstance(block, dict):
+                    with self._lock:
+                        self._scrapes[rep.index] = (time.time(), block)
 
     # -- canary promotion ---------------------------------------------------
 
@@ -916,22 +1176,103 @@ class ServeRouter:
 
     # -- record / metrics ----------------------------------------------------
 
+    # Scraped serve-block keys re-exposed per replica as labeled
+    # series on the router's /metrics (plus the scrape's own age).
+    _REPLICA_SERIES = (
+        ("requests", "tffm_serve_replica_requests_total", "counter"),
+        ("qps", "tffm_serve_replica_qps", "gauge"),
+        ("p50_ms", "tffm_serve_replica_p50_ms", "gauge"),
+        ("p99_ms", "tffm_serve_replica_p99_ms", "gauge"),
+        ("batch_fill", "tffm_serve_replica_batch_fill", "gauge"),
+        ("steady_compiles", "tffm_serve_replica_steady_compiles",
+         "gauge"),
+    )
+
+    def _fleet_aggregates(self, per: list, scrapes: dict,
+                          now: float) -> dict:
+        """Fleet-level aggregates over the latest per-replica /status
+        scrapes: sums for the monotonic counters and rates, a
+        request-weighted mean for p50, MAX for the tails (a merged
+        p99 cannot be computed from per-replica percentiles — the max
+        is the honest conservative bound), and the scrape staleness
+        the alert plane watches."""
+        blocks = [
+            (scrapes[p["index"]], p["index"])
+            for p in per if p["index"] in scrapes
+        ]
+        if not blocks:
+            return {"replicas_scraped": 0}
+        out = {"replicas_scraped": len(blocks)}
+        for key in ("requests", "examples", "batches", "qps",
+                    "steady_compiles", "recompiles_unexpected"):
+            vals = [b.get(key) for (_, b), _i in blocks]
+            vals = [v for v in vals if isinstance(v, (int, float))]
+            if vals:
+                out[f"fleet_{key}"] = round(sum(vals), 2)
+        weights = [
+            max(1, int((b.get("requests") or 0)))
+            for (_, b), _i in blocks
+        ]
+        p50s = [
+            (b.get("p50_ms"), w)
+            for ((_, b), _i), w in zip(blocks, weights)
+            if isinstance(b.get("p50_ms"), (int, float))
+        ]
+        if p50s:
+            out["fleet_p50_ms"] = round(
+                sum(v * w for v, w in p50s) / sum(w for _, w in p50s),
+                4,
+            )
+        for key in ("p95_ms", "p99_ms", "max_ms"):
+            vals = [
+                b.get(key) for (_, b), _i in blocks
+                if isinstance(b.get(key), (int, float))
+            ]
+            if vals:
+                out[f"fleet_{key}"] = round(max(vals), 4)
+        fills = [
+            b.get("batch_fill") for (_, b), _i in blocks
+            if isinstance(b.get("batch_fill"), (int, float))
+        ]
+        if fills:
+            out["fleet_batch_fill"] = round(
+                sum(fills) / len(fills), 6
+            )
+        out["fleet_scrape_age_max_s"] = round(
+            max(now - t for (t, _b), _i in blocks), 3
+        )
+        return out
+
     def _build(self, kind: str = "status") -> dict:
         now = time.time()
         wall = max(now - self._t0, 1e-9)
+        # SLO gauges refresh BEFORE the snapshot so one scrape's gauge
+        # spellings agree with its serve-block keys.
+        slo_block = self._slo.snapshot()
         snap = self._tel.snapshot()
         counters = snap.get("counters") or {}
         timers = snap.get("timers") or {}
         with self._lock:
+            scrapes = dict(self._scrapes)
             per = [
                 {
                     "index": r.index, "port": r.port, "pid": r.pid,
                     "healthy": r.healthy,
                     "quarantined": r.quarantined,
+                    "respawning": r.respawn_pending is not None,
                     "inflight": r.inflight, "routed": r.routed,
                 }
                 for r in self._replicas
             ]
+        for p in per:
+            scraped = scrapes.get(p["index"])
+            if scraped is not None:
+                t, b = scraped
+                p["scrape_age_s"] = round(now - t, 3)
+                for key in ("qps", "p50_ms", "p99_ms", "requests",
+                            "batch_fill", "steady_compiles"):
+                    if key in b:
+                        p[key] = b[key]
         requests = int(counters.get("serve.router_requests", 0))
         shed = int(counters.get("serve.shed", 0))
         block = {
@@ -947,6 +1288,7 @@ class ServeRouter:
                 counters.get("serve.readmissions", 0)
             ),
             "retries": int(counters.get("serve.retries", 0)),
+            "respawns": int(counters.get("serve.respawns", 0)),
             "canary_promotions": int(
                 counters.get("serve.canary_promotions", 0)
             ),
@@ -955,11 +1297,13 @@ class ServeRouter:
             ),
             "per_replica": per,  # /status detail; non-numeric, so the
         }                        # Prometheus rendering skips it
+        block.update(self._fleet_aggregates(per, scrapes, now))
+        block.update(slo_block)
         proxy = timers.get("serve.proxy") or {}
         for key in ("mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
             if key in proxy:
                 block[key] = proxy[key]
-        return {
+        rec = {
             "record": kind,
             "time": now,
             "elapsed": round(wall, 3),
@@ -967,6 +1311,9 @@ class ServeRouter:
             "serve": block,
             "stages": snap,
         }
+        if self._tracer.enabled:
+            rec["trace_dropped_events"] = self._tracer.dropped_events
+        return rec
 
     def _render_metrics(self) -> str:
         record = self._build("status")
@@ -990,6 +1337,26 @@ class ServeRouter:
                 f'tffm_serve_replica_routed_total{{replica='
                 f'"{p["index"]}"}} {p["routed"]}'
             )
+        # Fleet scrape re-exposition: the per-replica serve blocks the
+        # health loop pulled, as labeled series — one router scrape
+        # sees the whole fleet.
+        for key, name, mtype in self._REPLICA_SERIES:
+            rows = [p for p in per if key in p]
+            if not rows:
+                continue
+            lines.append(f"# TYPE {name} {mtype}")
+            for p in rows:
+                lines.append(
+                    f'{name}{{replica="{p["index"]}"}} {p[key]}'
+                )
+        rows = [p for p in per if "scrape_age_s" in p]
+        if rows:
+            lines.append("# TYPE tffm_serve_replica_scrape_age_s gauge")
+            for p in rows:
+                lines.append(
+                    f'tffm_serve_replica_scrape_age_s{{replica='
+                    f'"{p["index"]}"}} {p["scrape_age_s"]}'
+                )
         return "\n".join(lines) + "\n"
 
     def close(self) -> None:
@@ -1014,18 +1381,21 @@ class ServeRouter:
 class FleetHandle:
     """One running router + replica fleet; ``close()`` tears it down in
     order (router stops routing, replicas terminate, final record
-    written)."""
+    written, router trace dumped)."""
 
     def __init__(self, cfg, manager, router, telemetry, writer,
-                 heartbeat):
+                 heartbeat, tracer=None, alert_engine=None):
         self.cfg = cfg
         self.manager = manager
         self.router = router
         self.replicas = router._replicas
         self.telemetry = telemetry
         self.port = router.port
+        self.alert_engine = alert_engine
+        self.exception: Optional[BaseException] = None
         self._writer = writer
         self._heartbeat = heartbeat
+        self._tracer = tracer
         self._closed = False
 
     def close(self) -> None:
@@ -1039,10 +1409,27 @@ class FleetHandle:
             self.manager.close()
         if self._writer is not None:
             try:
-                self._writer.write(self.router._build("final"))
+                final = self.router._build("final")
+                if self.exception is not None:
+                    # Crash-truthful final (an alert halt, a fatal
+                    # mount error): the stream names why the fleet
+                    # stopped, same contract as the trainer's.
+                    final["exception"] = type(self.exception).__name__
+                    final["exception_msg"] = str(self.exception)
+                self._writer.write(final)
             except Exception as e:  # noqa: BLE001 - teardown best-effort
                 log.warning("router final record write failed: %s", e)
             self._writer.close()
+        if self._tracer is not None and self._tracer.enabled:
+            try:
+                n = self._tracer.dump(self.cfg.trace_file)
+                self._tracer.close()
+                log.info(
+                    "router trace written to %s (%d events)",
+                    self.cfg.trace_file, n,
+                )
+            except Exception as e:  # noqa: BLE001 - teardown best-effort
+                log.warning("router trace dump failed: %s", e)
 
 
 def start_fleet(cfg: FmConfig, cfg_path: str,
@@ -1059,16 +1446,38 @@ def start_fleet(cfg: FmConfig, cfg_path: str,
         obs.JsonlWriter(cfg.metrics_file) if cfg.metrics_file else None
     )
     telemetry = obs.Telemetry(enabled=cfg.telemetry)
+    # The router's half of the distributed trace (admit + proxy spans,
+    # flow arrows keyed on the request id); replicas write their own
+    # trace_file.replicaN halves and report.py --serve-trace re-joins
+    # the family.
+    tracer = (
+        Tracer(
+            enabled=True, process_name="router",
+            rotate_events=cfg.trace_rotate_events,
+            rotate_path=cfg.trace_file or None,
+        )
+        if cfg.trace_file else NULL_TRACER
+    )
     manifest_seen = manifest.read_manifest(cfg.model_file)
     manager = None
     router = None
     heartbeat = None
+    # Alert watchdog on the ROUTER's heartbeat: the serve-signal rules
+    # (shed_frac, burn_rate, evictions, fleet_scrape_age_max_s, ...)
+    # evaluate against every fleet heartbeat; action=halt arms the
+    # engine and serve_fleet stops the fleet (crash-truthful final).
+    alert_engine = None
+    if cfg.alert_rules:
+        alert_engine = obs.AlertEngine(
+            obs.parse_rules(cfg.alert_rules), writer=writer
+        )
     try:
         manager = ReplicaManager(cfg, cfg_path, overrides=overrides)
         router = ServeRouter(
             cfg.serve_port if port is None else port,
             manager.replicas, cfg, telemetry=telemetry, writer=writer,
             host=cfg.serve_host, manifest_seen=manifest_seen,
+            tracer=tracer, respawner=manager.respawn,
         )
         if writer is not None:
             writer.write({
@@ -1085,13 +1494,23 @@ def start_fleet(cfg: FmConfig, cfg_path: str,
                 "serve_canary": cfg.serve_canary,
                 "serve_transport": cfg.serve_transport,
                 "serve_poll_secs": cfg.serve_poll_secs,
+                "serve_trace_sample": cfg.serve_trace_sample,
+                "serve_slo_p99_ms": cfg.serve_slo_p99_ms,
+                "serve_slo_availability": cfg.serve_slo_availability,
+                "alert_rules": cfg.alert_rules,
+                "trace_file": cfg.trace_file,
                 "replica_ports": [r.port for r in manager.replicas],
             })
+
+        def heartbeat_build():
+            rec = router._build("heartbeat")
+            if rec is not None and alert_engine is not None:
+                alert_engine.observe(rec)
+            return rec
+
         if cfg.heartbeat_secs > 0:
             heartbeat = obs.Heartbeat(
-                cfg.heartbeat_secs,
-                lambda: router._build("heartbeat"),
-                writer=writer,
+                cfg.heartbeat_secs, heartbeat_build, writer=writer,
             )
     except BaseException:
         # A failed mount must not leak replica processes or threads.
@@ -1101,6 +1520,8 @@ def start_fleet(cfg: FmConfig, cfg_path: str,
             manager.close()
         if writer is not None:
             writer.close()
+        if tracer is not NULL_TRACER:
+            tracer.close()
         raise
     log.info(
         "router listening on %s:%d over %d replicas (POST /score, "
@@ -1108,14 +1529,18 @@ def start_fleet(cfg: FmConfig, cfg_path: str,
         cfg.serve_host, router.port, len(manager.replicas),
     )
     return FleetHandle(cfg, manager, router, telemetry, writer,
-                       heartbeat)
+                       heartbeat, tracer=tracer,
+                       alert_engine=alert_engine)
 
 
 def serve_fleet(cfg: FmConfig, cfg_path: str,
                 overrides: Optional[dict] = None) -> int:
     """CLI entry for ``run_tffm.py serve <cfg> --replicas N``: route
     until interrupted.  SIGTERM and SIGINT both tear the fleet down —
-    the replica subprocesses must never outlive their router."""
+    the replica subprocesses must never outlive their router.  An
+    armed ``action: halt`` alert rule (burn rate, shed fraction,
+    staleness) stops the fleet with a crash-truthful final record —
+    the serving spelling of the training watchdog's halt contract."""
     handle = start_fleet(cfg, cfg_path, overrides=overrides)
     print(
         f"routing on {cfg.serve_host}:{handle.port} across "
@@ -1127,10 +1552,17 @@ def serve_fleet(cfg: FmConfig, cfg_path: str,
 
     prev = signal.signal(signal.SIGTERM, _sigterm)
     try:
-        threading.Event().wait()
+        obs.run_until_halt(handle.alert_engine)
     except KeyboardInterrupt:
         log.info("interrupted; shutting down the fleet")
-    finally:
+    except obs.AlertHaltError as e:
+        log.error("HALT: %s", e)
+        handle.exception = e
         handle.close()
+        signal.signal(signal.SIGTERM, prev)
+        return 1
+    finally:
+        if not handle._closed:
+            handle.close()
         signal.signal(signal.SIGTERM, prev)
     return 0

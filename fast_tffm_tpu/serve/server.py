@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import json
 import logging
+import signal
 import threading
 import time
 from typing import Optional
@@ -54,9 +55,11 @@ from fast_tffm_tpu import obs
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data import libsvm
 from fast_tffm_tpu.obs.status import ObsHTTPServer, QuietHandler
+from fast_tffm_tpu.obs.trace import NULL_TRACER, Tracer
 from fast_tffm_tpu.serve import wire
 from fast_tffm_tpu.serve.batcher import ServeBatcher
 from fast_tffm_tpu.serve import scorer as scorer_lib
+from fast_tffm_tpu.serve.slo import SloTracker
 from fast_tffm_tpu.train import checkpoint
 
 log = logging.getLogger(__name__)
@@ -234,8 +237,16 @@ class ServeServer:
 
     def __init__(self, port: int, batcher: ServeBatcher, cfg: FmConfig,
                  build, telemetry=None, host: str = "127.0.0.1",
-                 timeout_s: float = 30.0, scorer=None):
+                 timeout_s: float = 30.0, scorer=None, tracer=None,
+                 sampler=None, slo=None):
         tel = telemetry if telemetry is not None else obs.NULL
+        tracer = tracer if tracer is not None else NULL_TRACER
+        # Request-id mint + trace-sampling coin flip for DIRECT
+        # traffic (a router stamps ids before they arrive; a
+        # single-process server is its own front door).
+        sampler = sampler if sampler is not None else wire.RequestSampler(
+            cfg.serve_trace_sample, enabled=tracer.enabled, tag="s"
+        )
         requests_c = tel.counter("serve.http_requests")
         truncated_c = tel.counter("serve.truncated_features")
         # Per-request libsvm-text parse time: PR 9 flagged text parsing
@@ -254,30 +265,52 @@ class ServeServer:
         server = self
 
         def score_arrays(handler, ids, vals, fields, n, truncated,
-                         encode) -> None:
+                         encode, rid=None) -> None:
             """Shared tail of both transports: count integrity events,
-            batch-score, encode the response."""
+            batch-score, encode the response.  ``rid`` (a sampled or
+            client-supplied request id) is echoed in the response's
+            ``X-Request-Id`` header and closes the request's span
+            chain with a ``serve.respond`` span."""
             if truncated:
                 # Same integrity signal the ingest path counts: a
                 # truncated example scores as a different example.
                 truncated_c.add(truncated)
+            rid_hdr = {"X-Request-Id": rid} if rid is not None else None
             if n == 0:
                 ctype, body = encode(np.zeros((0,), np.float32))
-                handler._send(200, body, ctype)
+                handler._send(200, body, ctype, headers=rid_hdr)
                 return
             try:
                 scores = batcher.score(
                     ids, vals,
                     fields if cfg.field_num else None,
-                    timeout=timeout_s,
+                    timeout=timeout_s, rid=rid,
                 )
             except Exception as e:  # noqa: BLE001 - report, don't die
+                if slo is not None:
+                    # The batcher's ledger only sees requests its
+                    # dispatcher finishes; an HTTP-layer failure (a
+                    # scoring timeout, a closed batcher) is a 503 the
+                    # CLIENT saw — without this, a 503 storm would
+                    # read as burn_rate 0.
+                    slo.observe(False)
                 handler._send(
-                    503, f"scoring failed: {e}\n".encode(), "text/plain"
+                    503, f"scoring failed: {e}\n".encode(),
+                    "text/plain", headers=rid_hdr,
                 )
                 return
+            t_r0 = time.perf_counter()
             ctype, body = encode(scores)
-            handler._send(200, body, ctype)
+            handler._send(200, body, ctype, headers=rid_hdr)
+            if rid is not None:
+                # Chain tail: scores -> encoded -> written back to the
+                # client; the flow end ("f") binds the arrow from the
+                # dispatch step to this span.
+                tracer.emit(
+                    "serve.respond", t_r0,
+                    time.perf_counter() - t_r0,
+                    args={"rid": rid, "n": n}, flow=("f", rid),
+                )
 
         def encode_text(scores):
             return "text/plain", "".join(
@@ -309,22 +342,47 @@ class ServeServer:
                 body = self._read_body(_MAX_BODY_BYTES)
                 if body is None:
                     return  # error response already sent
+                # Request id: the X-Request-Id header (either
+                # transport), overridden by the binary frame's own
+                # trailer (the router stamps SAMPLED frames there).
+                # Invalid ids (empty/oversized/control chars) are
+                # ignored, not errors — tracing must never fail a
+                # scoring request.
+                rid = self.headers.get("X-Request-Id")
+                if rid is not None and not wire.valid_request_id(rid):
+                    rid = None
                 try:
                     if path == "/score":
                         with parse_t.time():
                             parsed = parse_request(body.decode(), cfg)
+                        ids, vals, fields, n, truncated = parsed
                     else:
                         with parse_bin_t.time():
-                            parsed = decode_bin_request(body, cfg)
+                            (ids, vals, fields, n, truncated,
+                             frame_rid) = decode_bin_request(body, cfg)
+                        # Same sanitization as the header path: the
+                        # rid echoes into a response HEADER, so a
+                        # trailer smuggling CR/LF (or non-latin-1
+                        # bytes send_header can't write) must be
+                        # dropped, never reflected.
+                        if frame_rid is not None and \
+                                wire.valid_request_id(frame_rid):
+                            rid = frame_rid
                 except (ValueError, UnicodeDecodeError) as e:
                     self._send(
                         400, f"bad request: {e}\n".encode(), "text/plain"
                     )
                     return
-                ids, vals, fields, n, truncated = parsed
+                if rid is None and sampler.sample():
+                    # Direct traffic with no upstream id: this server
+                    # is the front door, so it mints (and samples)
+                    # itself.  Unsampled requests never reach here
+                    # with any id work done.
+                    rid = sampler.mint()
                 score_arrays(
                     self, ids, vals, fields, n, truncated,
                     encode_text if path == "/score" else encode_bin,
+                    rid=rid,
                 )
 
             def _do_admin(self, path: str, query: str) -> None:
@@ -409,10 +467,11 @@ class ServeServer:
 class ServeHandle:
     """One running serving stack; ``close()`` tears it down in order
     (HTTP stops accepting, batcher drains/fails, watcher stops, final
-    record written)."""
+    record written, trace dumped)."""
 
     def __init__(self, cfg, scorer, batcher, server, watcher, telemetry,
-                 writer, heartbeat, build):
+                 writer, heartbeat, build, tracer=None,
+                 alert_engine=None):
         self.cfg = cfg
         self.scorer = scorer
         self.batcher = batcher
@@ -420,9 +479,12 @@ class ServeHandle:
         self.watcher = watcher
         self.telemetry = telemetry
         self.port = server.port
+        self.alert_engine = alert_engine
+        self.exception: Optional[BaseException] = None
         self._writer = writer
         self._heartbeat = heartbeat
         self._build = build
+        self._tracer = tracer
         self._closed = False
 
     def close(self) -> None:
@@ -439,10 +501,27 @@ class ServeHandle:
             try:
                 final = self._build("final")
                 if final is not None:
+                    if self.exception is not None:
+                        # Crash-truthful final: same contract as the
+                        # trainer's try/finally final record.
+                        final["exception"] = type(
+                            self.exception
+                        ).__name__
+                        final["exception_msg"] = str(self.exception)
                     self._writer.write(final)
             except Exception as e:  # noqa: BLE001 - teardown best-effort
                 log.warning("serve final record write failed: %s", e)
             self._writer.close()
+        if self._tracer is not None and self._tracer.enabled:
+            try:
+                n = self._tracer.dump(self.cfg.trace_file)
+                self._tracer.close()
+                log.info(
+                    "serve trace written to %s (%d events)",
+                    self.cfg.trace_file, n,
+                )
+            except Exception as e:  # noqa: BLE001 - teardown best-effort
+                log.warning("serve trace dump failed: %s", e)
 
 
 def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
@@ -511,6 +590,21 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
         obs.JsonlWriter(cfg.metrics_file) if cfg.metrics_file else None
     )
     telemetry = obs.Telemetry(enabled=cfg.telemetry)
+    # Per-request distributed tracing (serve_trace_sample) + any
+    # future serve-path spans land here; trace_file unset = the shared
+    # no-op tracer, zero behavior change (same contract as training).
+    tracer = (
+        Tracer(
+            enabled=True, process_name="serve",
+            rotate_events=cfg.trace_rotate_events,
+            rotate_path=cfg.trace_file or None,
+        )
+        if cfg.trace_file else NULL_TRACER
+    )
+    slo = SloTracker(
+        cfg.serve_slo_p99_ms, cfg.serve_slo_availability,
+        telemetry=telemetry,
+    )
     # Watcher baseline BEFORE the load: a checkpoint published while we
     # load/warm up must look NEW to the first poll (the scorer may or
     # may not have caught it; re-swapping to the same step is a cheap
@@ -527,6 +621,8 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
         # model dir must not accumulate leaked fds).
         if writer is not None:
             writer.close()
+        if tracer is not NULL_TRACER:
+            tracer.close()
         raise
     log.info(
         "scorer ready: checkpoint step %d, ladder %s, %d rung(s) "
@@ -535,22 +631,32 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
     )
     batcher = ServeBatcher(
         scorer, max_batch_wait_ms=cfg.max_batch_wait_ms,
-        queue_size=cfg.queue_size, telemetry=telemetry,
+        queue_size=cfg.queue_size, telemetry=telemetry, tracer=tracer,
+        slo=slo,
     )
     t0 = time.time()
 
     def build(kind: str = "status"):
         now = time.time()
         wall = max(now - t0, 1e-9)
+        # SLO gauges refresh BEFORE the snapshot so one scrape sees
+        # block keys and gauge spellings agree.
+        slo_block = slo.snapshot()
         snap = telemetry.snapshot()
+        serve_block = _serve_block(snap, scorer, batcher, wall)
+        serve_block.update(slo_block)
         rec = {
             "record": kind,
             "time": now,
             "elapsed": round(wall, 3),
             "step": scorer.step,
-            "serve": _serve_block(snap, scorer, batcher, wall),
+            "serve": serve_block,
             "stages": snap,
         }
+        if tracer.enabled:
+            rec["trace_dropped_events"] = tracer.dropped_events
+            if cfg.trace_rotate_events:
+                rec["trace_windows"] = tracer.windows_written
         return rec
 
     if writer is not None:
@@ -568,11 +674,27 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             "telemetry": cfg.telemetry,
             "heartbeat_secs": cfg.heartbeat_secs,
         })
+    # Alert watchdog riding the serve heartbeat (same contract as the
+    # trainer's: FmConfig guarantees heartbeat_secs > 0 when rules are
+    # set; breaches write `record: alert`; an action=halt rule arms
+    # engine.halted, which serve_forever raises as AlertHaltError —
+    # an embedder polls handle.alert_engine itself).
+    alert_engine = None
+    if cfg.alert_rules:
+        alert_engine = obs.AlertEngine(
+            obs.parse_rules(cfg.alert_rules), writer=writer
+        )
+
+    def heartbeat_build():
+        rec = build("heartbeat")
+        if rec is not None and alert_engine is not None:
+            alert_engine.observe(rec)
+        return rec
+
     heartbeat = None
     if cfg.heartbeat_secs > 0:
         heartbeat = obs.Heartbeat(
-            cfg.heartbeat_secs, lambda: build("heartbeat"),
-            writer=writer,
+            cfg.heartbeat_secs, heartbeat_build, writer=writer,
         )
     watcher = None
     try:
@@ -584,7 +706,8 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
         server = ServeServer(
             cfg.serve_port if port is None else port,
             batcher, cfg, build, telemetry=telemetry,
-            host=cfg.serve_host, scorer=scorer,
+            host=cfg.serve_host, scorer=scorer, tracer=tracer,
+            slo=slo,
         )
     except BaseException:
         # A taken port (or watcher failure) must not leak the batcher
@@ -596,6 +719,8 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             heartbeat.close()
         if writer is not None:
             writer.close()
+        if tracer is not NULL_TRACER:
+            tracer.close()
         raise
     log.info(
         "scoring endpoint listening on %s:%d (POST /score; GET "
@@ -604,18 +729,36 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
     )
     return ServeHandle(
         cfg, scorer, batcher, server, watcher, telemetry, writer,
-        heartbeat, build,
+        heartbeat, build, tracer=tracer, alert_engine=alert_engine,
     )
 
 
 def serve_forever(cfg: FmConfig) -> int:
-    """CLI entry: serve until interrupted (SIGINT -> clean close)."""
+    """CLI entry: serve until interrupted.  SIGTERM and SIGINT both
+    close cleanly — a replica torn down by its router's manager
+    (terminate -> wait) must still write its final record and dump its
+    trace.  An armed ``action: halt`` alert rule stops the process
+    with the crash-truthful final record (AlertHaltError), the same
+    watchdog contract as training."""
     handle = serve(cfg)
     print(f"serving on {cfg.serve_host}:{handle.port}", flush=True)
+
+    def _sigterm(signum, frame):  # pragma: no cover - signal path
+        raise KeyboardInterrupt
+
+    prev = signal.signal(signal.SIGTERM, _sigterm)
     try:
-        threading.Event().wait()
+        obs.run_until_halt(handle.alert_engine)
     except KeyboardInterrupt:
         log.info("interrupted; shutting down the scoring endpoint")
-    finally:
+    except obs.AlertHaltError as e:
+        log.error("HALT: %s", e)
+        handle.exception = e
         handle.close()
+        signal.signal(signal.SIGTERM, prev)
+        return 1
+    finally:
+        if not handle._closed:
+            handle.close()
+        signal.signal(signal.SIGTERM, prev)
     return 0

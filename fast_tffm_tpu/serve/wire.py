@@ -11,13 +11,25 @@ LITTLE-ENDIAN (documented in SERVING.md "Binary frame layout"):
                n      u32    examples in the frame (0 allowed)
                f      u32    features per example AS SENT
                flags  u8     bit 0 = a fields array follows
+                             bit 1 = a request-id trailer follows
                ids    i32[n*f]   row-major [n, f]
                vals   f32[n*f]
                fields i32[n*f]   present iff flags bit 0
+               ridlen u16        present iff flags bit 1
+               rid    u8[ridlen] utf-8 request id (distributed-trace
+                                 correlation; <= 128 bytes)
 
     response:  magic  u8[4]  = b"TFB1"
                n      u32
                scores f32[n]     same order as the request's examples
+
+The request-id trailer is the binary transport's spelling of the
+``X-Request-Id`` header (SERVING.md "Request ids & distributed
+tracing"): the router appends it to SAMPLED frames so the id rides the
+frame itself across the proxy hop, and clients may set it directly.
+It sits AFTER the arrays so the zero-copy ``np.frombuffer`` views are
+untouched, and a frame without it is bit-for-bit the pre-trailer
+layout — unsampled proxying stays byte-identical.
 
 ``f`` may differ from the server's ``max_features``: narrower frames
 zero-pad (``vals == 0`` slots are mathematically inert), wider ones
@@ -33,15 +45,21 @@ paying a jax import.
 
 from __future__ import annotations
 
+import itertools
+import os
+import random
 import struct
+import time
 
 import numpy as np
 
 from fast_tffm_tpu.config import FmConfig
 
 __all__ = [
-    "BIN_MAGIC", "MAX_BODY_BYTES", "decode_bin_request",
-    "decode_bin_response", "encode_bin_request", "encode_bin_response",
+    "BIN_MAGIC", "MAX_BODY_BYTES", "MAX_REQUEST_ID_BYTES",
+    "RequestSampler", "decode_bin_request", "decode_bin_response",
+    "encode_bin_request", "encode_bin_response",
+    "peek_bin_request_id", "valid_request_id", "with_bin_request_id",
 ]
 
 # POST body cap shared by every scoring endpoint (text and binary, the
@@ -53,12 +71,33 @@ MAX_BODY_BYTES = 64 << 20
 BIN_MAGIC = b"TFB1"
 _BIN_HDR = struct.Struct("<4sIIB")
 _BIN_RESP_HDR = struct.Struct("<4sI")
+_RID_LEN = struct.Struct("<H")
+
+# Frame flag bits.
+_FLAG_FIELDS = 1
+_FLAG_RID = 2
+
+# Request-id cap (header values and frame trailers): ids are short
+# correlation tokens, and an unauthenticated endpoint must not let a
+# client inflate every span/log line with an arbitrary-length blob.
+MAX_REQUEST_ID_BYTES = 128
 
 
-def encode_bin_request(ids, vals, fields=None) -> bytes:
+def _rid_bytes(request_id: str) -> bytes:
+    raw = request_id.encode("utf-8")
+    if not raw or len(raw) > MAX_REQUEST_ID_BYTES:
+        raise ValueError(
+            f"request id must be 1..{MAX_REQUEST_ID_BYTES} utf-8 "
+            f"bytes, got {len(raw)}"
+        )
+    return raw
+
+
+def encode_bin_request(ids, vals, fields=None,
+                       request_id=None) -> bytes:
     """``[n, f]`` arrays -> one request frame (the client half; tests,
     bench and the smoke build frames with it or from the documented
-    layout directly)."""
+    layout directly).  ``request_id`` adds the flags-bit-1 trailer."""
     ids = np.ascontiguousarray(ids, np.int32)
     vals = np.ascontiguousarray(vals, np.float32)
     if ids.shape != vals.shape or ids.ndim != 2:
@@ -67,8 +106,11 @@ def encode_bin_request(ids, vals, fields=None) -> bytes:
             f"{ids.shape} vs {vals.shape}"
         )
     n, f = ids.shape
+    flags = (_FLAG_FIELDS if fields is not None else 0) | (
+        _FLAG_RID if request_id is not None else 0
+    )
     parts = [
-        _BIN_HDR.pack(BIN_MAGIC, n, f, 1 if fields is not None else 0),
+        _BIN_HDR.pack(BIN_MAGIC, n, f, flags),
         ids.tobytes(), vals.tobytes(),
     ]
     if fields is not None:
@@ -78,14 +120,48 @@ def encode_bin_request(ids, vals, fields=None) -> bytes:
                 f"fields shape {fields.shape} != ids shape {ids.shape}"
             )
         parts.append(fields.tobytes())
+    if request_id is not None:
+        raw = _rid_bytes(request_id)
+        parts.append(_RID_LEN.pack(len(raw)) + raw)
     return b"".join(parts)
 
 
+def _trailer_rid(data: bytes, arrays_end: int):
+    """Decode the flags-bit-1 request-id trailer starting at
+    ``arrays_end``; returns the id string.  Raises ValueError on a
+    malformed trailer (wrong length accounting, empty/oversized id,
+    non-utf-8 bytes)."""
+    if len(data) < arrays_end + _RID_LEN.size:
+        raise ValueError(
+            "frame flags announce a request-id trailer but the body "
+            "ends before its length field"
+        )
+    (ridlen,) = _RID_LEN.unpack_from(data, arrays_end)
+    if not 0 < ridlen <= MAX_REQUEST_ID_BYTES:
+        raise ValueError(
+            f"request-id trailer length {ridlen} outside "
+            f"(0, {MAX_REQUEST_ID_BYTES}]"
+        )
+    end = arrays_end + _RID_LEN.size + ridlen
+    if len(data) != end:
+        raise ValueError(
+            f"frame length {len(data)} != {end} expected with a "
+            f"{ridlen}-byte request-id trailer"
+        )
+    try:
+        return data[arrays_end + _RID_LEN.size:end].decode("utf-8")
+    except UnicodeDecodeError:
+        raise ValueError(
+            "request-id trailer is not valid utf-8"
+        ) from None
+
+
 def decode_bin_request(data: bytes, cfg: FmConfig):
-    """One request frame -> ``(ids, vals, fields, n, truncated)`` with
-    the arrays padded/truncated to ``[n, cfg.max_features]`` — the same
-    contract as ``server.parse_request``, minus the text parse.  Raises
-    ValueError (-> HTTP 400) on a malformed frame."""
+    """One request frame -> ``(ids, vals, fields, n, truncated, rid)``
+    with the arrays padded/truncated to ``[n, cfg.max_features]`` — the
+    same contract as ``server.parse_request``, minus the text parse.
+    ``rid`` is the request-id trailer (None without flags bit 1).
+    Raises ValueError (-> HTTP 400) on a malformed frame."""
     if len(data) < _BIN_HDR.size:
         raise ValueError(
             f"frame too short for the header ({len(data)} bytes)"
@@ -95,7 +171,7 @@ def decode_bin_request(data: bytes, cfg: FmConfig):
         raise ValueError(
             f"bad frame magic {magic!r} (want {BIN_MAGIC!r})"
         )
-    has_fields = bool(flags & 1)
+    has_fields = bool(flags & _FLAG_FIELDS)
     if n and not f:
         # Zero features per example would make the length check
         # vacuous: an n-of-billions header over a 13-byte body must
@@ -103,7 +179,10 @@ def decode_bin_request(data: bytes, cfg: FmConfig):
         raise ValueError(f"frame claims n={n} examples with f=0")
     cells = n * f
     want = _BIN_HDR.size + cells * (12 if has_fields else 8)
-    if len(data) != want:
+    rid = None
+    if flags & _FLAG_RID:
+        rid = _trailer_rid(data, want)
+    elif len(data) != want:
         raise ValueError(
             f"frame length {len(data)} != {want} expected for n={n} "
             f"f={f} fields={has_fields}"
@@ -148,7 +227,43 @@ def decode_bin_request(data: bytes, cfg: FmConfig):
     ids = _reduce_mod(ids, cfg.vocabulary_size)
     if fields is not None and cfg.field_num:
         fields = _reduce_mod(fields, cfg.field_num)
-    return ids, vals, fields, int(n), truncated
+    return ids, vals, fields, int(n), truncated, rid
+
+
+def peek_bin_request_id(data: bytes):
+    """The request-id trailer of a frame, WITHOUT decoding the arrays
+    (the router's proxy path reads it in O(header)).  Returns None for
+    frames without flags bit 1 or too short to carry a header; raises
+    ValueError only for a frame that claims a trailer it doesn't
+    carry (the replica's full decode rejects it the same way)."""
+    if len(data) < _BIN_HDR.size:
+        return None
+    magic, n, f, flags = _BIN_HDR.unpack_from(data)
+    if magic != BIN_MAGIC or not flags & _FLAG_RID:
+        return None
+    cells = n * f
+    arrays_end = _BIN_HDR.size + cells * (
+        12 if flags & _FLAG_FIELDS else 8
+    )
+    return _trailer_rid(data, arrays_end)
+
+
+def with_bin_request_id(data: bytes, request_id: str) -> bytes:
+    """A copy of ``data`` carrying ``request_id`` as its flags-bit-1
+    trailer (the router stamps SAMPLED frames with this before
+    proxying).  A frame that already carries a trailer keeps it — the
+    client's id wins, same precedence as the X-Request-Id header."""
+    if len(data) < _BIN_HDR.size:
+        return data  # malformed; the replica's decode will 400 it
+    magic, n, f, flags = _BIN_HDR.unpack_from(data)
+    if magic != BIN_MAGIC or flags & _FLAG_RID:
+        return data
+    raw = _rid_bytes(request_id)
+    return (
+        _BIN_HDR.pack(BIN_MAGIC, n, f, flags | _FLAG_RID)
+        + data[_BIN_HDR.size:]
+        + _RID_LEN.pack(len(raw)) + raw
+    )
 
 
 def _reduce_mod(arr: np.ndarray, modulus: int) -> np.ndarray:
@@ -162,6 +277,52 @@ def _reduce_mod(arr: np.ndarray, modulus: int) -> np.ndarray:
     if modulus <= 0x7FFFFFFF:
         return np.mod(arr, np.int32(modulus))
     return (arr.astype(np.int64) % modulus).astype(np.int32)
+
+
+def valid_request_id(rid) -> bool:
+    """A usable client-supplied request id: non-empty, within the byte
+    cap, printable ASCII only.  The id is echoed in a response HEADER:
+    CR/LF would be response splitting, and anything http.server's
+    latin-1-strict header encoder can't write would corrupt the
+    kept-alive stream mid-response — so both the header path and the
+    binary frame's trailer are screened through this before the id is
+    ever reflected."""
+    if not rid or len(rid) > MAX_REQUEST_ID_BYTES:
+        return False
+    return all(0x20 <= ord(ch) <= 0x7E for ch in rid)
+
+
+class RequestSampler:
+    """Request-id mint + the per-request trace-sampling decision.
+
+    One instance per serving process (router or single server).  Ids
+    are ``<tag>-<pid.in.hex>-<boot.ms>-<counter>`` — unique across the
+    fleet's processes (pid + boot time) and under concurrency
+    (``itertools.count``'s atomic ``next``).  ``sample()`` answers the
+    ``serve_trace_sample`` coin flip; with sampling off it is a single
+    attribute compare, and NO id is ever minted for an unsampled
+    request (the no-allocation-on-the-unsampled-path contract the
+    serving tests pin).
+    """
+
+    def __init__(self, sample: float, enabled: bool = True,
+                 tag: str = "r"):
+        self.rate = float(sample) if enabled else 0.0
+        self._prefix = (
+            f"{tag}-{os.getpid():x}-{int(time.time() * 1e3) & 0xFFFFFFFF:x}"
+        )
+        self._counter = itertools.count()
+        # random.Random.random() is one C call — atomic under the GIL,
+        # so concurrent handler threads need no lock around it.
+        self._rng = random.Random(os.getpid() ^ 0x5EED)
+
+    def sample(self) -> bool:
+        if self.rate <= 0.0:
+            return False
+        return self.rate >= 1.0 or self._rng.random() < self.rate
+
+    def mint(self) -> str:
+        return f"{self._prefix}-{next(self._counter)}"
 
 
 def encode_bin_response(scores) -> bytes:

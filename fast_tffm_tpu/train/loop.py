@@ -1756,14 +1756,7 @@ class Trainer:
                         alert_engine is not None
                         and alert_engine.halted is not None
                     ):
-                        a = alert_engine.halted
-                        raise obs.AlertHaltError(
-                            f"alert rule {a['rule']} fired with "
-                            f"action=halt at step {a['step']}: "
-                            f"{a['signal']}={a['value']} {a['op']} "
-                            f"{a['threshold']} (sustained "
-                            f"{a['sustain']} heartbeat(s))"
-                        )
+                        raise obs.halt_error(alert_engine.halted)
                     if profiling and stepno >= profile_stop_at:
                         jax.block_until_ready(self.state)
                         jax.profiler.stop_trace()

@@ -24,9 +24,11 @@ import pytest
 
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.obs.status import ObsHTTPServer, QuietHandler
+from fast_tffm_tpu.obs.trace import Tracer
 from fast_tffm_tpu import obs
 from fast_tffm_tpu.serve import wire
 from fast_tffm_tpu.serve.router import Replica, ServeRouter
+from fast_tffm_tpu.serve.slo import SloTracker
 from fast_tffm_tpu.train import checkpoint
 
 
@@ -39,7 +41,7 @@ class FakeReplica:
     the same keep-prev/rollback semantics as the real scorer.
     """
 
-    def __init__(self, score=0.5, delay_s=0.0):
+    def __init__(self, score=0.5, delay_s=0.0, status_block=None):
         self.score = score
         self.delay_s = delay_s
         self.healthy = True
@@ -53,6 +55,12 @@ class FakeReplica:
         self.connections = 0
         self.reload_status = 200
         self.rollback_status = 200
+        # The serve block /status answers (None = 503, the historical
+        # fake with no observability surface); the router's fleet
+        # scraper consumes it.
+        self.status_block = status_block
+        self.last_body = None     # raw bytes of the last scoring POST
+        self.last_headers = None  # its headers (dict)
         fake = self
 
         class Handler(QuietHandler):
@@ -63,6 +71,17 @@ class FakeReplica:
             def do_GET(self) -> None:  # noqa: N802
                 if self.path == "/healthz" and fake.healthy:
                     self._send(200, b"ok\n", "text/plain")
+                elif (
+                    self.path == "/status"
+                    and fake.status_block is not None
+                    and fake.healthy
+                ):
+                    doc = {"record": "status",
+                           "serve": dict(fake.status_block)}
+                    self._send(
+                        200, (json.dumps(doc) + "\n").encode(),
+                        "application/json",
+                    )
                 else:
                     self._send(503, b"unhealthy\n", "text/plain")
 
@@ -71,6 +90,10 @@ class FakeReplica:
                 if body is None:
                     return
                 fake.requests += 1
+                if self.path.partition("?")[0] in ("/score",
+                                                   "/score_bin"):
+                    fake.last_body = body
+                    fake.last_headers = dict(self.headers)
                 if fake.delay_s:
                     time.sleep(fake.delay_s)
                 path, _, query = self.path.partition("?")
@@ -84,7 +107,8 @@ class FakeReplica:
                                   range(n))
                     self._send(200, out.encode(), "text/plain")
                 elif self.path == "/score_bin":
-                    _ids, _vals, _f, n, _tr = wire.decode_bin_request(
+                    (_ids, _vals, _f, n, _tr,
+                     _rid) = wire.decode_bin_request(
                         body, FakeReplica._CFG
                     )
                     self._send(
@@ -165,7 +189,8 @@ class FakeReplica:
         self._httpd.server_close()
 
 
-def _mk_router(fakes, tmp_path, health_secs=10.0, **cfg_kw):
+def _mk_router(fakes, tmp_path, health_secs=10.0, tracer=None,
+               sampler=None, respawner=None, **cfg_kw):
     """A router over fakes.  health_secs defaults high so dispatch
     tests control health state themselves."""
     defaults = dict(
@@ -181,6 +206,7 @@ def _mk_router(fakes, tmp_path, health_secs=10.0, **cfg_kw):
     tel = obs.Telemetry()
     router = ServeRouter(
         0, replicas, cfg, telemetry=tel, health_secs=health_secs,
+        tracer=tracer, sampler=sampler, respawner=respawner,
     )
     return router, replicas, tel
 
@@ -706,6 +732,512 @@ class TestFleetLaunch:
         assert proc.returncode == 0, proc.stderr.decode()
 
 
+def _post_with_headers(port, path, body, headers=None, timeout=30):
+    """(status, body, response headers); HTTPError codes return."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST",
+        headers=headers or {},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+class TestRequestId:
+    """ISSUE 14 tentpole: the request-id contract through the router
+    (SERVING.md "Request ids & distributed tracing")."""
+
+    def test_client_id_echoes_through_both_transports(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, _, _ = _mk_router(fakes, tmp_path)
+        try:
+            status, _, hdrs = _post_with_headers(
+                router.port, "/score", b"1 3:1\n",
+                headers={"X-Request-Id": "client-abc-1"},
+            )
+            assert status == 200
+            assert hdrs.get("X-Request-Id") == "client-abc-1"
+            # The id propagated to the replica as a header.
+            fake = next(f for f in fakes if f.last_headers)
+            assert fake.last_headers.get("X-Request-Id") == \
+                "client-abc-1"
+            ids = np.zeros((1, 4), np.int32)
+            status, _, hdrs = _post_with_headers(
+                router.port, "/score_bin",
+                wire.encode_bin_request(ids, np.ones((1, 4),
+                                                     np.float32)),
+                headers={"X-Request-Id": "client-abc-2"},
+            )
+            assert status == 200
+            assert hdrs.get("X-Request-Id") == "client-abc-2"
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_invalid_client_id_is_ignored_not_fatal(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, _, _ = _mk_router(fakes, tmp_path)
+        try:
+            status, _, hdrs = _post_with_headers(
+                router.port, "/score", b"1 3:1\n",
+                headers={"X-Request-Id": "x" * 300},  # over the cap
+            )
+            assert status == 200
+            assert "X-Request-Id" not in hdrs
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_minted_ids_unique_under_concurrency(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        tracer = Tracer(enabled=True)
+        router, _, _ = _mk_router(
+            fakes, tmp_path, tracer=tracer,
+            sampler=wire.RequestSampler(1.0, enabled=True, tag="t"),
+        )
+        try:
+            seen = []
+            lock = threading.Lock()
+
+            def client():
+                for _ in range(10):
+                    status, _, hdrs = _post_with_headers(
+                        router.port, "/score", b"1 3:1\n"
+                    )
+                    assert status == 200
+                    with lock:
+                        seen.append(hdrs.get("X-Request-Id"))
+
+            threads = [
+                threading.Thread(target=client) for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(rid for rid in seen), "a sampled response " \
+                "lost its X-Request-Id echo"
+            assert len(set(seen)) == len(seen) == 40, (
+                "minted request ids collided under concurrency"
+            )
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_sampling_off_proxies_byte_identical(self, tmp_path):
+        """The no-id-work contract: with sampling off and no client
+        id, the proxied body is EXACTLY what the client sent (no frame
+        trailer, no header) and the response carries no echo."""
+        fakes = [FakeReplica(), FakeReplica()]
+        router, _, _ = _mk_router(fakes, tmp_path)
+        try:
+            ids = np.arange(8, dtype=np.int32).reshape(2, 4)
+            vals = np.ones((2, 4), np.float32)
+            frame = wire.encode_bin_request(ids, vals)
+            status, _, hdrs = _post_with_headers(
+                router.port, "/score_bin", frame
+            )
+            assert status == 200
+            assert "X-Request-Id" not in hdrs
+            fake = next(f for f in fakes if f.last_body is not None)
+            assert fake.last_body == frame, (
+                "unsampled binary frame was rewritten in transit"
+            )
+            assert "X-Request-Id" not in fake.last_headers
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_sampled_bin_frame_carries_the_trailer(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        tracer = Tracer(enabled=True)
+        router, _, _ = _mk_router(
+            fakes, tmp_path, tracer=tracer,
+            sampler=wire.RequestSampler(1.0, enabled=True, tag="t"),
+        )
+        try:
+            ids = np.zeros((1, 4), np.int32)
+            frame = wire.encode_bin_request(
+                ids, np.ones((1, 4), np.float32)
+            )
+            status, _, hdrs = _post_with_headers(
+                router.port, "/score_bin", frame
+            )
+            assert status == 200
+            rid = hdrs.get("X-Request-Id")
+            assert rid
+            fake = next(f for f in fakes if f.last_body is not None)
+            assert fake.last_body != frame  # trailer appended
+            assert wire.peek_bin_request_id(fake.last_body) == rid
+            # ... and the replica-side decode agrees.
+            out = wire.decode_bin_request(
+                fake.last_body, FakeReplica._CFG
+            )
+            assert out[5] == rid
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_router_spans_cover_admit_and_proxy(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        tracer = Tracer(enabled=True)
+        router, _, _ = _mk_router(
+            fakes, tmp_path, tracer=tracer,
+            sampler=wire.RequestSampler(1.0, enabled=True, tag="t"),
+        )
+        try:
+            status, _, hdrs = _post_with_headers(
+                router.port, "/score", b"1 3:1\n"
+            )
+            assert status == 200
+            rid = hdrs["X-Request-Id"]
+            events = tracer.take()
+            spans = {
+                ev["name"]: ev for ev in events
+                if ev.get("ph") == "X"
+                and (ev.get("args") or {}).get("rid") == rid
+            }
+            assert "serve.admit" in spans
+            assert "serve.proxy" in spans
+            assert spans["serve.admit"]["args"]["decision"] == "admit"
+            assert spans["serve.proxy"]["args"]["replica"] in (0, 1)
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
+class TestWireTrailer:
+    def test_trailer_roundtrip(self):
+        cfg = FakeReplica._CFG
+        ids = np.arange(8, dtype=np.int32).reshape(2, 4)
+        vals = np.full((2, 4), 0.5, np.float32)
+        frame = wire.encode_bin_request(ids, vals, request_id="rid-9")
+        out = wire.decode_bin_request(frame, cfg)
+        np.testing.assert_array_equal(out[0], ids)
+        assert out[5] == "rid-9"
+        assert wire.peek_bin_request_id(frame) == "rid-9"
+        # Arrays are untouched by the trailer: the rid-less prefix is
+        # bitwise the rid-less frame (minus the flags bit).
+        bare = wire.encode_bin_request(ids, vals)
+        assert wire.peek_bin_request_id(bare) is None
+        stamped = wire.with_bin_request_id(bare, "rid-10")
+        assert wire.peek_bin_request_id(stamped) == "rid-10"
+        assert stamped[13:13 + len(bare) - 13] == bare[13:]
+        # An existing trailer wins (client precedence).
+        again = wire.with_bin_request_id(stamped, "other")
+        assert wire.peek_bin_request_id(again) == "rid-10"
+
+    def test_malformed_trailers_are_rejected(self):
+        cfg = FakeReplica._CFG
+        ids = np.zeros((1, 4), np.int32)
+        vals = np.ones((1, 4), np.float32)
+        frame = wire.encode_bin_request(ids, vals, request_id="abc")
+        with pytest.raises(ValueError):
+            wire.decode_bin_request(frame[:-1], cfg)  # short trailer
+        with pytest.raises(ValueError):
+            wire.decode_bin_request(frame + b"x", cfg)  # long
+        # flags bit set but no trailer bytes at all
+        import struct
+        bare = wire.encode_bin_request(ids, vals)
+        lying = struct.pack("<4sIIB", b"TFB1", 1, 4, 2) + bare[13:]
+        with pytest.raises(ValueError):
+            wire.decode_bin_request(lying, cfg)
+
+    def test_valid_request_id_screens_header_hazards(self):
+        assert wire.valid_request_id("req-1.a_b")
+        # Reflected into a response header: CR/LF is response
+        # splitting, non-ASCII breaks http.server's latin-1-strict
+        # header write mid-stream, empty/oversized are junk.
+        assert not wire.valid_request_id("evil\r\nX-Injected: 1")
+        assert not wire.valid_request_id("café")
+        assert not wire.valid_request_id("")
+        assert not wire.valid_request_id(None)
+        assert not wire.valid_request_id("x" * 200)
+
+    def test_fields_and_trailer_compose(self):
+        cfg = FmConfig(vocabulary_size=256, factor_num=4,
+                       max_features=4, field_num=3)
+        ids = np.zeros((2, 4), np.int32)
+        vals = np.ones((2, 4), np.float32)
+        fields = np.ones((2, 4), np.int32)
+        frame = wire.encode_bin_request(
+            ids, vals, fields, request_id="both-1"
+        )
+        out = wire.decode_bin_request(frame, cfg)
+        assert out[2] is not None and out[5] == "both-1"
+        assert wire.peek_bin_request_id(frame) == "both-1"
+
+
+class TestFleetScrape:
+    _BLOCK = {
+        "requests": 5, "examples": 10, "batches": 2, "qps": 2.5,
+        "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0, "max_ms": 4.0,
+        "batch_fill": 0.5, "steady_compiles": 0,
+    }
+
+    def test_health_loop_scrapes_and_aggregates(self, tmp_path):
+        fakes = [
+            FakeReplica(status_block=dict(self._BLOCK)),
+            FakeReplica(status_block=dict(self._BLOCK, qps=7.5,
+                                          p99_ms=9.0)),
+        ]
+        router, _, _ = _mk_router(fakes, tmp_path, health_secs=0.05)
+        try:
+            deadline = time.time() + 10
+            while len(router._scrapes) < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            assert len(router._scrapes) == 2, "fleet scrape never ran"
+            blk = router._build()["serve"]
+            assert blk["replicas_scraped"] == 2
+            assert blk["fleet_requests"] == 10
+            assert blk["fleet_examples"] == 20
+            assert blk["fleet_qps"] == 10.0
+            assert blk["fleet_p99_ms"] == 9.0  # max-merge
+            assert blk["fleet_scrape_age_max_s"] >= 0
+            # Per-replica detail rides /status...
+            per = {p["index"]: p for p in blk["per_replica"]}
+            assert per[1]["qps"] == 7.5
+            assert "scrape_age_s" in per[0]
+            # ...and /metrics exposes the labeled series.
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/metrics", timeout=10
+            ).read().decode()
+            assert 'tffm_serve_replica_qps{replica="1"} 7.5' in text
+            assert "tffm_serve_fleet_requests 10" in text
+            assert "tffm_serve_fleet_p99_ms 9.0" in text
+            assert 'tffm_serve_replica_scrape_age_s{replica="0"}' \
+                in text
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_statusless_replicas_degrade_to_no_aggregates(
+        self, tmp_path
+    ):
+        fakes = [FakeReplica(), FakeReplica()]  # no /status surface
+        router, _, tel = _mk_router(fakes, tmp_path, health_secs=0.05)
+        try:
+            deadline = time.time() + 2
+            while time.time() < deadline and not tel.snapshot()[
+                "counters"
+            ].get("serve.scrape_errors"):
+                time.sleep(0.05)
+            blk = router._build()["serve"]
+            assert blk["replicas_scraped"] == 0
+            assert "fleet_requests" not in blk
+            assert tel.snapshot()["counters"][
+                "serve.scrape_errors"
+            ] >= 1
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
+class TestSlo:
+    def test_tracker_burn_rate_math(self):
+        tr = SloTracker(50.0, 0.9)  # budget = 0.1
+        now = 1000.0
+        for _ in range(8):
+            tr.observe(True, 0.001, now=now)     # good
+        tr.observe(True, 0.2, now=now)           # over the 50ms SLO
+        tr.observe(False, now=now)               # shed
+        snap = tr.snapshot(now=now)
+        assert snap["slo_good"] == 8 and snap["slo_bad"] == 2
+        assert snap["slo_bad_frac"] == pytest.approx(0.2)
+        assert snap["burn_rate"] == pytest.approx(2.0)  # 0.2 / 0.1
+        # The window slides: outcomes age out.
+        snap = tr.snapshot(now=now + 120.0)
+        assert snap["slo_good"] == 0 and snap["slo_bad"] == 0
+        assert snap["burn_rate"] == 0.0
+
+    def test_tracker_disabled_without_knobs(self):
+        tr = SloTracker(0.0, 0.0)
+        tr.observe(True, 0.001)
+        assert tr.snapshot() == {}
+
+    def test_router_burn_rate_counts_sheds(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        router, reps, _ = _mk_router(
+            fakes, tmp_path, serve_shed_deadline_ms=10.0,
+            serve_slo_p99_ms=10_000.0, serve_slo_availability=0.9,
+        )
+        try:
+            for _ in range(8):
+                status, _ = _post(router.port, "/score", b"1 3:1\n")
+                assert status == 200
+            # Force the admission ledger into shed territory.
+            now = time.perf_counter()
+            with router._lock:
+                reps[0].inflight = 3
+                reps[1].inflight = 3
+                for i in range(100):
+                    router._completions.append(now - i * 0.01)
+            status, _ = _post(router.port, "/score", b"1 3:1\n")
+            assert status == 429
+            blk = router._build()["serve"]
+            assert blk["slo_bad"] >= 1
+            assert blk["burn_rate"] > 0
+            assert blk["slo_availability"] == 0.9
+            # The alert plane reads it through the serve-signal alias.
+            engine = obs.AlertEngine(
+                obs.parse_rules("burn_rate > 0.1 : warn")
+            )
+            fired = engine.observe(router._build("heartbeat"))
+            assert len(fired) == 1
+            assert fired[0]["signal"] == "burn_rate"
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
+class _FakePendingProc:
+    """A _ReplicaProc-shaped handle for the respawn state machine."""
+
+    def __init__(self, index):
+        self.index = index
+        self.port = None
+        self.ready = threading.Event()
+        self.proc = _FakePopen()
+
+    def announce(self, port):
+        self.port = port
+        self.proc._alive = True
+        self.ready.set()
+
+    def die(self):
+        self.proc._alive = False
+        self.ready.set()
+
+
+class _FakePopen:
+    def __init__(self, alive=False):
+        self._alive = alive
+        self.pid = 4242
+
+    def poll(self):
+        return None if self._alive else 1
+
+
+class TestRespawn:
+    def _dead_managed_router(self, fakes, tmp_path, respawner):
+        router, reps, tel = _mk_router(
+            fakes, tmp_path, respawner=respawner
+        )
+        # Managed replica whose process has died.
+        reps[0].proc = _FakePopen(alive=False)
+        return router, reps, tel
+
+    def test_dead_managed_replica_respawns_and_readopts(
+        self, tmp_path
+    ):
+        fakes = [FakeReplica(), FakeReplica()]
+        spawned = []
+
+        def respawner(index):
+            p = _FakePendingProc(index)
+            spawned.append(p)
+            return p
+
+        router, reps, tel = self._dead_managed_router(
+            fakes, tmp_path, respawner
+        )
+        try:
+            rep = reps[0]
+            router._evict(rep, "test: process died")
+            router._respawn_step(rep)
+            assert len(spawned) == 1
+            assert rep.respawn_pending is spawned[0]
+            assert tel.snapshot()["counters"]["serve.respawns"] == 1
+            # Not ready yet: polling is a no-op.
+            router._respawn_poll(rep)
+            assert rep.respawn_pending is spawned[0]
+            # Port announced -> adopted; health loop may readmit.
+            spawned[0].announce(fakes[0].port)
+            router._respawn_poll(rep)
+            assert rep.respawn_pending is None
+            assert rep.port == fakes[0].port
+            assert rep.proc is spawned[0].proc
+            # The real (fake) replica answers /healthz -> readmission
+            # resets the backoff counter.
+            assert router._probe_health(rep)
+            router._readmit(rep)
+            assert rep.healthy and rep.respawn_fails == 0
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_respawn_backoff_doubles_and_caps(self, tmp_path):
+        from fast_tffm_tpu.serve import router as router_mod
+
+        fakes = [FakeReplica(), FakeReplica()]
+        spawned = []
+
+        def respawner(index):
+            p = _FakePendingProc(index)
+            spawned.append(p)
+            return p
+
+        router, reps, _ = self._dead_managed_router(
+            fakes, tmp_path, respawner
+        )
+        try:
+            rep = reps[0]
+            delays = []
+            for k in range(7):
+                rep.next_respawn_t = 0.0  # due now
+                t0 = time.monotonic()
+                router._respawn_step(rep)
+                assert len(spawned) == k + 1
+                delays.append(rep.next_respawn_t - t0)
+                # This attempt dies before announcing a port.
+                spawned[-1].die()
+                router._respawn_poll(rep)
+                assert rep.respawn_pending is None
+            base = router_mod._RESPAWN_BASE_S
+            cap = router_mod._RESPAWN_CAP_S
+            for k, d in enumerate(delays):
+                assert d == pytest.approx(
+                    min(cap, base * 2 ** k), abs=0.25
+                )
+            # While the backoff clock hasn't expired, no new attempt.
+            rep.next_respawn_t = time.monotonic() + 60
+            router._respawn_step(rep)
+            assert len(spawned) == 7
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+    def test_unmanaged_replica_keeps_evict_only(self, tmp_path):
+        fakes = [FakeReplica(), FakeReplica()]
+        spawned = []
+        router, reps, _ = _mk_router(
+            fakes, tmp_path,
+            respawner=lambda i: spawned.append(i),
+        )
+        try:
+            rep = reps[0]  # proc is None: unmanaged host:port replica
+            router._respawn_step(rep)
+            assert not spawned
+            assert rep.respawn_pending is None
+        finally:
+            router.close()
+            for f in fakes:
+                f.close()
+
+
 class TestConfig:
     def test_canary_requires_a_fleet(self):
         with pytest.raises(ValueError, match="serve_replicas"):
@@ -721,3 +1253,43 @@ class TestConfig:
             FmConfig(serve_replicas=-1)
         with pytest.raises(ValueError, match="serve_shed_deadline_ms"):
             FmConfig(serve_shed_deadline_ms=-1)
+
+    def test_observability_knob_validation(self):
+        # Silently-inert-knob discipline: sampling needs a trace file.
+        with pytest.raises(ValueError, match="serve_trace_sample"):
+            FmConfig(serve_trace_sample=0.5)
+        with pytest.raises(ValueError, match="serve_trace_sample"):
+            FmConfig(serve_trace_sample=1.5, trace_file="/tmp/t.json")
+        FmConfig(serve_trace_sample=0.5, trace_file="/tmp/t.json")
+        with pytest.raises(ValueError, match="serve_slo_availability"):
+            FmConfig(serve_slo_availability=1.0)
+        with pytest.raises(ValueError, match="serve_slo_p99_ms"):
+            FmConfig(serve_slo_p99_ms=-1)
+        FmConfig(serve_slo_p99_ms=50.0, serve_slo_availability=0.999)
+
+    def test_replica_command_neutralizes_trace_sampling(self, tmp_path):
+        """An INI fleet with serve_trace_sample set must not let the
+        children self-sample (router-less partial chains) — and each
+        replica gets its own suffixed trace path."""
+        from fast_tffm_tpu.config import load_config
+        from fast_tffm_tpu.serve.router import _replica_command
+
+        cfg_path = tmp_path / "fleet.cfg"
+        cfg_path.write_text(
+            "[General]\nvocabulary_size = 64\nfactor_num = 4\n"
+            f"model_file = {tmp_path}/model\n"
+            "[Predict]\nserve_replicas = 2\n"
+            f"[Train]\ntrace_file = {tmp_path}/trace.json\n"
+            "serve_trace_sample = 0.5\n"
+            "heartbeat_secs = 1\n"
+            "alert_rules = burn_rate > 10 : halt\n"
+        )
+        cfg = load_config(str(cfg_path))
+        cmd = _replica_command(cfg, str(cfg_path), 1, {})
+        assert cmd[cmd.index("--serve_trace_sample") + 1] == "0"
+        assert cmd[cmd.index("--trace") + 1] == \
+            f"{tmp_path}/trace.json.replica1"
+        # The router owns the watchdog: an INI halt rule leaking into
+        # a replica would self-halt it and the respawn policy would
+        # relaunch it forever.
+        assert cmd[cmd.index("--alert_rules") + 1] == ""

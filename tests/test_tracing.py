@@ -865,3 +865,224 @@ class TestCompare:
         assert rc == 0  # identical run: no regression against itself
         out = capsys.readouterr().out
         assert "examples_in" in out
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: per-request distributed tracing across the serve fleet
+# ---------------------------------------------------------------------------
+
+
+class TestServeTrace:
+    """A sampled request through an (in-process) 2-replica router
+    renders as ONE connected cross-process chain — router admit ->
+    proxy -> replica queue wait -> coalesce -> rung dispatch ->
+    respond — and ``tools/report.py --serve-trace`` walks it.  The
+    unsampled path stays bitwise-identical (same score bytes, no
+    X-Request-Id, zero spans)."""
+
+    _CFG_KW = dict(
+        vocabulary_size=64, factor_num=4, max_features=4,
+        serve_batch_sizes="8", max_batch_wait_ms=1.0,
+    )
+
+    @pytest.fixture(scope="class")
+    def fleet(self, tmp_path_factory):
+        import urllib.request
+
+        import jax
+
+        from fast_tffm_tpu.models import fm
+        from fast_tffm_tpu.serve import wire
+        from fast_tffm_tpu.serve.batcher import ServeBatcher
+        from fast_tffm_tpu.serve.router import Replica, ServeRouter
+        from fast_tffm_tpu.serve.scorer import FixedShapeScorer
+        from fast_tffm_tpu.serve.server import ServeServer
+
+        tmp = tmp_path_factory.mktemp("serve_trace")
+        cfg = FmConfig(model_file=str(tmp / "model"), **self._CFG_KW)
+        params = jax.jit(
+            lambda k: fm.init_params(k, cfg=cfg)
+        )(jax.random.PRNGKey(0))
+        stacks = []
+        replicas = []
+        for i in range(2):
+            tracer = obs.Tracer(enabled=True,
+                                process_name=f"replica{i}")
+            scorer = FixedShapeScorer(cfg, params)
+            scorer.warmup()
+            batcher = ServeBatcher(
+                scorer, max_batch_wait_ms=cfg.max_batch_wait_ms,
+                tracer=tracer,
+            )
+            server = ServeServer(
+                0, batcher, cfg, lambda: {"record": "status"},
+                tracer=tracer,
+            )
+            stacks.append((tracer, batcher, server))
+            replicas.append(Replica(i, "127.0.0.1", server.port))
+        router_tracer = obs.Tracer(enabled=True,
+                                   process_name="router")
+        rcfg = FmConfig(model_file=str(tmp / "model"),
+                        serve_replicas=2, **self._CFG_KW)
+        router = ServeRouter(
+            0, replicas, rcfg, health_secs=10.0,
+            tracer=router_tracer,
+            sampler=wire.RequestSampler(1.0, enabled=True, tag="rt"),
+        )
+
+        def post(path, body, headers=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}{path}", data=body,
+                method="POST", headers=headers or {},
+            )
+            resp = urllib.request.urlopen(req, timeout=30)
+            return resp.status, resp.read(), dict(resp.headers)
+
+        yield {
+            "router": router, "router_tracer": router_tracer,
+            "stacks": stacks, "post": post, "tmp": tmp,
+        }
+        router.close()
+        for _, batcher, server in stacks:
+            server.close()
+            batcher.close()
+
+    def _dump_all(self, fleet):
+        tmp = fleet["tmp"]
+        paths = []
+        router_path = str(tmp / "trace.json")
+        fleet["router_tracer"].dump(router_path)
+        paths.append(router_path)
+        for i, (tracer, _, _) in enumerate(fleet["stacks"]):
+            p = str(tmp / f"trace.json.replica{i}")
+            tracer.dump(p)
+            paths.append(p)
+        return paths
+
+    def test_sampled_request_chain_is_complete(self, fleet):
+        status, body, hdrs = fleet["post"](
+            "/score", b"1 3:1\n0 2:0.5\n"
+        )
+        assert status == 200
+        rid = hdrs.get("X-Request-Id")
+        assert rid, "sampled request lost its id echo"
+        assert len(body.decode().split()) == 2
+        paths = self._dump_all(fleet)
+        events, _, _ = report.merge_traces(paths)
+        chains = report.serve_request_chains(events)
+        mine = [c for c in chains if c["rid"] == rid]
+        assert len(mine) == 1
+        chain = mine[0]
+        assert chain["complete"], (
+            f"chain missing segments: {sorted(chain['spans'])}"
+        )
+        for seg in ("admit", "proxy", "queue_wait", "coalesce",
+                    "dispatch", "respond"):
+            assert seg in chain["spans"], seg
+        assert chain["replica"] in (0, 1)
+        # The replica half carries the SAME rid the router minted:
+        # the spans came from different Tracer instances, joined only
+        # by the propagated id.
+        assert chain["spans"]["dispatch"]["args"]["rid"] == rid
+        # Flow arrows: start at the proxy, step at the dispatch, end
+        # at the respond — the Perfetto-visible connection.
+        flows = [
+            ev for ev in events
+            if ev.get("cat") == "tffm_flow" and ev.get("id") == rid
+        ]
+        assert {f["ph"] for f in flows} == {"s", "t", "f"}
+
+    def test_sampled_score_bin_chain_is_complete(self, fleet):
+        """The acceptance shape: a sampled /score_bin request — the id
+        rides the frame's flags-bit-1 trailer across the proxy hop —
+        still reconstructs the full cross-process chain."""
+        from fast_tffm_tpu.serve import wire
+
+        ids = np.zeros((2, 4), np.int32)
+        vals = np.ones((2, 4), np.float32)
+        status, body, hdrs = fleet["post"](
+            "/score_bin", wire.encode_bin_request(ids, vals),
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        assert status == 200
+        rid = hdrs.get("X-Request-Id")
+        assert rid
+        assert len(wire.decode_bin_response(body)) == 2
+        paths = self._dump_all(fleet)
+        events, _, _ = report.merge_traces(paths)
+        chains = [
+            c for c in report.serve_request_chains(events)
+            if c["rid"] == rid
+        ]
+        assert len(chains) == 1 and chains[0]["complete"], (
+            f"bin chain: {sorted(chains[0]['spans']) if chains else []}"
+        )
+
+    def test_report_serve_trace_mode(self, fleet, capsys):
+        for _ in range(3):
+            status, _, _ = fleet["post"]("/score", b"1 3:1\n")
+            assert status == 200
+        paths = self._dump_all(fleet)
+        rc = report.main(["--serve-trace"] + paths)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "sampled requests:" in out
+        assert "critical path" in out
+        assert "dispatch" in out
+
+    def test_unsampled_serving_is_bitwise_identical(
+        self, tmp_path_factory
+    ):
+        import urllib.request
+
+        import jax
+
+        from fast_tffm_tpu.models import fm
+        from fast_tffm_tpu.serve import wire
+        from fast_tffm_tpu.serve.batcher import ServeBatcher
+        from fast_tffm_tpu.serve.scorer import FixedShapeScorer
+        from fast_tffm_tpu.serve.server import ServeServer
+
+        tmp = tmp_path_factory.mktemp("serve_trace_off")
+        cfg = FmConfig(model_file=str(tmp / "model"), **self._CFG_KW)
+        params = jax.jit(
+            lambda k: fm.init_params(k, cfg=cfg)
+        )(jax.random.PRNGKey(0))
+        scorer = FixedShapeScorer(cfg, params)
+        scorer.warmup()
+
+        def serve_once(tracer, sampler):
+            batcher = ServeBatcher(scorer, tracer=tracer)
+            server = ServeServer(
+                0, batcher, cfg, lambda: {"record": "status"},
+                tracer=tracer, sampler=sampler,
+            )
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}/score",
+                    data=b"1 3:1\n0 2:0.5\n", method="POST",
+                )
+                resp = urllib.request.urlopen(req, timeout=30)
+                return resp.read(), dict(resp.headers)
+            finally:
+                server.close()
+                batcher.close()
+
+        off_tracer = obs.Tracer(enabled=True)  # enabled, NOT sampled
+        body_off, hdrs_off = serve_once(
+            off_tracer, wire.RequestSampler(0.0, enabled=True)
+        )
+        on_tracer = obs.Tracer(enabled=True)
+        body_on, hdrs_on = serve_once(
+            on_tracer, wire.RequestSampler(1.0, enabled=True)
+        )
+        # Scores are bitwise-identical with tracing on or off...
+        assert body_off == body_on
+        # ...the unsampled response carries no id header...
+        assert "X-Request-Id" not in hdrs_off
+        assert "X-Request-Id" in hdrs_on
+        # ...and the unsampled path emitted ZERO spans (no-op spans,
+        # no id allocation — the satellite contract).
+        assert off_tracer.take() == []
+        assert [e for e in on_tracer.take()
+                if e.get("ph") == "X"] != []

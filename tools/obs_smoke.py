@@ -28,17 +28,30 @@ checkpoint that run just wrote — ``run_tffm.py serve`` in a subprocess:
    (``tffm_counter_serve_swaps_total`` reaches 1) while still scoring.
 
 Then the ROUTER smoke (scale-out serving, SERVING.md "Scale-out") —
-``run_tffm.py serve --replicas 2`` in a subprocess:
+``run_tffm.py serve --replicas 2`` in a subprocess, with per-request
+tracing sampled at 1.0 (``--trace`` + ``--serve_trace_sample 1``):
 
 9.  the router answers ``/score`` AND the binary ``/score_bin`` (a
     hand-rolled frame pinning the documented wire layout) with
-    IDENTICAL scores for the same examples;
-10. SIGKILLing one replica mid-traffic loses no requests (transparent
+    IDENTICAL scores for the same examples, every response echoing an
+    ``X-Request-Id``;
+10. the router's ``/metrics`` exposes the FLEET: aggregated
+    ``tffm_serve_fleet_*`` series and per-replica labeled series
+    scraped from each replica's ``/status`` — one scrape sees the
+    whole fleet;
+11. SIGKILLing one replica MID-TRACE loses no requests (transparent
     retry) and the router's ``/metrics`` shows the eviction
     (``tffm_counter_serve_evictions_total`` >= 1, the replica's
     ``tffm_serve_replica_healthy`` series at 0);
-11. terminating the router tears down every replica subprocess — no
-    orphaned jax processes.
+12. the RESPAWN policy relaunches the killed managed replica
+    (``tffm_counter_serve_respawns_total`` >= 1) and the health loop
+    readmits it (``tffm_serve_replica_healthy{replica="0"} 1``);
+13. terminating the router tears down every replica subprocess — no
+    orphaned jax processes — and dumps the trace family;
+14. ``tools/report.py --serve-trace`` re-joins the router + surviving
+    replica traces into COMPLETE per-request chains (admit -> proxy ->
+    queue -> coalesce -> dispatch -> respond), the SIGKILLed
+    replica's lost spans notwithstanding.
 
 Exit 0 = all held; any other exit fails the audit.
 """
@@ -327,17 +340,21 @@ def check_serve(cfg_path: str, data: str) -> None:
 
 def check_router(cfg_path: str, data: str) -> None:
     """Router smoke: 2 replicas behind the P2C router, text/binary
-    parity over the socket, a SIGKILL mid-traffic, and teardown with
-    no orphaned replica processes."""
+    parity over the socket, fleet-aggregated /metrics, a SIGKILL
+    mid-trace with transparent retry + respawn, teardown with no
+    orphaned replica processes, and a complete merged request trace."""
     import signal
     import struct
 
     port = _free_port()
+    tmpdir = os.path.dirname(cfg_path)
+    trace_path = os.path.join(tmpdir, "serve_trace.json")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "run_tffm.py"), "serve",
          cfg_path, "--replicas", "2", "--serve_port", str(port),
-         "--serve_poll_secs", "0.2"],
+         "--serve_poll_secs", "0.2",
+         "--trace", trace_path, "--serve_trace_sample", "1.0"],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
     )
@@ -379,9 +396,15 @@ def check_router(cfg_path: str, data: str) -> None:
             "1 " + " ".join(f"{i}:{v}" for i, v in ex) + "\n"
             for ex in examples
         ).encode()
-        text_scores = urllib.request.urlopen(urllib.request.Request(
+        resp = urllib.request.urlopen(urllib.request.Request(
             f"{base}/score", data=text, method="POST",
-        ), timeout=30).read().decode().split()
+        ), timeout=30)
+        text_scores = resp.read().decode().split()
+        if not resp.headers.get("X-Request-Id"):
+            raise SystemExit(
+                "FAIL: sampled /score response carries no "
+                "X-Request-Id echo"
+            )
         frame = struct.pack("<4sIIB", b"TFB1", 2, 3, 0)
         frame += b"".join(
             struct.pack("<i", i) for ex in examples for i, _ in ex
@@ -406,6 +429,27 @@ def check_router(cfg_path: str, data: str) -> None:
                 f"FAIL: binary scores {bin_scores} != text scores "
                 f"{text_scores} for the same examples"
             )
+        # Fleet metrics aggregation: the health loop scrapes every
+        # replica's /status, and ONE router scrape must expose the
+        # aggregated tffm_serve_fleet_* series plus per-replica
+        # labeled series.
+        deadline = time.time() + 60
+        while True:
+            metrics = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10).read().decode()
+            if (
+                "tffm_serve_fleet_requests" in metrics
+                and 'tffm_serve_replica_qps{replica="0"}' in metrics
+                and 'tffm_serve_replica_qps{replica="1"}' in metrics
+            ):
+                break
+            if time.time() > deadline:
+                raise SystemExit(
+                    "FAIL: router /metrics never exposed the fleet "
+                    "aggregates / per-replica scraped series"
+                )
+            time.sleep(0.3)
+        check_prometheus(metrics)
         # Kill one replica mid-traffic: every request must keep
         # succeeding (the router retries in-flight requests on the
         # survivor) and the eviction must show on /metrics.
@@ -443,9 +487,49 @@ def check_router(cfg_path: str, data: str) -> None:
                 "FAIL: killed replica not marked unhealthy in the "
                 "per-replica /metrics series"
             )
+        # Respawn policy: the manager relaunches the killed MANAGED
+        # replica (capped backoff) and the health loop readmits it
+        # once its ladder is warm — the deadline is generous because
+        # the fresh process pays a full jax startup + warmup on a
+        # box already running two replicas.
+        deadline = time.time() + 300
+        while True:
+            metrics = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10).read().decode()
+            m = re.search(
+                r"^tffm_counter_serve_respawns_total (\d+)", metrics,
+                re.MULTILINE,
+            )
+            respawns = int(m.group(1)) if m else 0
+            healthy0 = re.search(
+                r'^tffm_serve_replica_healthy\{replica="0"[^}]*\} 1',
+                metrics, re.MULTILINE,
+            )
+            if respawns >= 1 and healthy0:
+                break
+            if time.time() > deadline:
+                raise SystemExit(
+                    f"FAIL: killed replica never respawned+readmitted "
+                    f"(respawns={respawns}, healthy0={bool(healthy0)})"
+                )
+            time.sleep(1.0)
+        # The respawned replica is a NEW pid: the teardown check below
+        # must track the live fleet, not the original pids.
+        status = json.loads(urllib.request.urlopen(
+            f"{base}/status", timeout=10).read())
+        pids = [p["pid"] for p in status["serve"]["per_replica"]
+                if p["pid"] is not None]
+        # Scoring still flows through the recovered fleet.
+        body = urllib.request.urlopen(urllib.request.Request(
+            f"{base}/score", data=text, method="POST",
+        ), timeout=30).read().decode()
+        if len(body.split()) != 2:
+            raise SystemExit("FAIL: scoring broken after the respawn")
         print(
             f"router smoke ok: 2 replicas, text==binary scores, "
-            f"20/20 requests after SIGKILL, eviction on /metrics"
+            f"fleet aggregates on /metrics, 20/20 requests after "
+            f"SIGKILL, eviction visible, {respawns} respawn(s) + "
+            f"readmission"
         )
     finally:
         if proc.poll() is None:
@@ -455,9 +539,10 @@ def check_router(cfg_path: str, data: str) -> None:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
-    # The manager's teardown contract: no replica outlives its router.
+    # The manager's teardown contract: no replica outlives its router
+    # — including the RESPAWNED one (pids was refreshed post-respawn).
     deadline = time.time() + 10
-    for pid in pids[1:]:
+    for pid in pids:
         while time.time() < deadline:
             try:
                 os.kill(pid, 0)
@@ -471,6 +556,48 @@ def check_router(cfg_path: str, data: str) -> None:
                 "(manager teardown leak)"
             )
     print("router teardown ok: no orphaned replica processes")
+    # Distributed-trace merge: the router trace + whatever replica
+    # traces survived (the SIGKILLed replica's die with it — that is
+    # the point of the mid-trace kill) must re-join into COMPLETE
+    # per-request chains under tools/report.py --serve-trace.
+    trace_files = [
+        p for p in (
+            trace_path,
+            trace_path + ".replica0",
+            trace_path + ".replica1",
+        ) if os.path.exists(p)
+    ]
+    if trace_path not in trace_files or len(trace_files) < 2:
+        raise SystemExit(
+            f"FAIL: trace family incomplete on disk: {trace_files} "
+            "(need the router trace + >= 1 replica trace)"
+        )
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "report.py"),
+         "--serve-trace"] + trace_files,
+        capture_output=True, timeout=120,
+    )
+    out = rep.stdout.decode(errors="replace")
+    if rep.returncode != 0:
+        sys.stderr.write(out[-2000:])
+        raise SystemExit(
+            f"FAIL: report.py --serve-trace exited {rep.returncode}"
+        )
+    m = re.search(
+        r"sampled requests: (\d+) traced, (\d+) with a complete chain",
+        out,
+    )
+    if not m or int(m.group(2)) < 1:
+        sys.stderr.write(out[-2000:])
+        raise SystemExit(
+            "FAIL: merged serve trace reconstructed no complete "
+            "request chain"
+        )
+    print(
+        f"serve-trace merge ok: {m.group(1)} request(s) traced, "
+        f"{m.group(2)} complete chain(s) across "
+        f"{len(trace_files)} file(s)"
+    )
 
 
 def main() -> int:

@@ -221,13 +221,21 @@ def _print_breakdown(rec: dict) -> None:
                     "p50_ms", "p95_ms", "p99_ms", "max_ms",
                     "parse_p50_ms", "batch_fill", "swaps", "compiles",
                     "steady_compiles", "recompiles_unexpected",
-                    "table_mb", "quant_error_max"):
+                    "table_mb", "quant_error_max",
+                    "shed", "shed_frac", "replicas",
+                    "replicas_healthy", "evictions", "respawns",
+                    "replicas_scraped", "fleet_qps", "fleet_p50_ms",
+                    "fleet_p99_ms", "fleet_scrape_age_max_s",
+                    "slo_bad_frac", "burn_rate"):
             if key in serve:
                 print(f"  {key:22s} {serve[key]}")
         if serve.get("steady_compiles"):
             print("  !! compiles happened AFTER warmup — a request "
                   "shape escaped the serve_batch_sizes ladder (a "
                   "multi-second latency cliff on the hot path)")
+        if serve.get("burn_rate", 0) > 1:
+            print("  !! SLO error budget is burning faster than it "
+                  "accrues (burn_rate > 1) — the fleet is out of SLO")
     else:
         print("\nserving: n/a (stream has no serve block — training "
               "run or pre-serve stream)")
@@ -679,6 +687,166 @@ def _chain_segments(chain: dict) -> dict:
     return segs
 
 
+# Serve-path request chain: sequential segments (the critical path a
+# request walks) in order, plus the router spans that wrap them.
+_SERVE_SEGMENTS = ("admit", "queue_wait", "coalesce", "dispatch",
+                   "respond")
+
+
+def serve_request_chains(events: list) -> list:
+    """Reconstruct per-request span chains from serving traces.
+
+    Join key: the ``rid`` arg every serve-path span carries
+    (``serve.admit`` / ``serve.proxy`` on the router,
+    ``serve.queue_wait`` / ``serve.coalesce`` / ``serve.dispatch`` /
+    ``serve.respond`` on the replica).  Unlike super-batch chains, rid
+    uniqueness is fleet-global (pid + boot time + counter), so chains
+    join across ALL files at once.  Returns one dict per rid:
+    {rid, replica, spans: {name: ev}, latency_us, complete}.
+    """
+    by_rid: dict = {}
+    routed = False
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if not name.startswith("serve."):
+            continue
+        rid = (ev.get("args") or {}).get("rid")
+        if rid is None:
+            continue
+        seg = name[len("serve."):]
+        if seg in ("admit", "proxy"):
+            routed = True
+        by_rid.setdefault(rid, {})[seg] = ev
+    chains = []
+    for rid, spans in by_rid.items():
+        starts = [ev["ts"] for ev in spans.values()]
+        ends = [ev["ts"] + ev.get("dur", 0) for ev in spans.values()]
+        # A shed request legitimately ends at the admit decision; a
+        # scored one must carry the full replica chain (and, behind a
+        # router, the proxy span).
+        decision = (spans.get("admit", {}).get("args") or {}).get(
+            "decision", "admit"
+        )
+        if decision != "admit":
+            complete = "admit" in spans
+        else:
+            need = {"queue_wait", "coalesce", "dispatch", "respond"}
+            if routed:
+                need |= {"admit", "proxy"}
+            complete = need <= set(spans)
+        replica = None
+        for seg in ("proxy", "dispatch", "admit"):
+            a = spans.get(seg, {}).get("args") or {}
+            if isinstance(a.get("replica"), int) and a["replica"] >= 0:
+                replica = a["replica"]
+                break
+        chains.append({
+            "rid": rid, "replica": replica, "spans": spans,
+            "decision": decision,
+            "latency_us": max(ends) - min(starts),
+            "complete": complete,
+        })
+    return chains
+
+
+def serve_trace_mode(paths: list, out: str, limit: int) -> int:
+    """``--serve-trace``: per-request critical-path breakdown across
+    the router + replica trace family, with slowest-replica
+    attribution."""
+    events, notes, _per_file = merge_traces(paths)
+    if not events:
+        print("no trace events")
+        return 1
+    if out:
+        with open(out, "w") as f:
+            json.dump(
+                {"traceEvents": events, "displayTimeUnit": "ms"}, f
+            )
+        print(f"merged {len(paths)} file(s), {len(events)} events -> "
+              f"{out}")
+    for note in notes:
+        print(f"  ! {note}")
+    chains = serve_request_chains(events)
+    if not chains:
+        print("no sampled serve requests in this trace "
+              "(serve_trace_sample = 0, or a training trace?)")
+        return 1
+    n_ok = sum(1 for c in chains if c["complete"])
+    n_shed = sum(1 for c in chains if c["decision"] != "admit")
+    print(f"\nsampled requests: {len(chains)} traced, {n_ok} with a "
+          f"complete chain"
+          + (f", {n_shed} shed/unrouted" if n_shed else ""))
+    if n_ok < len(chains):
+        bad = [c["rid"] for c in chains if not c["complete"]][:5]
+        print(f"  ! incomplete chains (first 5 rids): {bad}")
+        print("    (a SIGKILLed replica's spans die with it — its "
+              "requests retried elsewhere keep only the router half)")
+
+    slowest = sorted(chains, key=lambda c: -c["latency_us"])[:limit]
+    print(f"\ncritical path — slowest {len(slowest)} request(s) "
+          f"(admit -> queue -> coalesce -> dispatch -> respond):")
+    for c in slowest:
+        parts = []
+        prev_end = None
+        for seg in _SERVE_SEGMENTS:
+            ev = c["spans"].get(seg)
+            if ev is None:
+                continue
+            ts, dur = ev["ts"], ev.get("dur", 0)
+            if prev_end is not None and ts > prev_end:
+                parts.append(f"(+{(ts - prev_end) / 1e3:.2f} gap)")
+            parts.append(f"{seg} {dur / 1e3:.2f}")
+            prev_end = ts + dur
+        proxy = c["spans"].get("proxy")
+        if proxy is not None:
+            parts.append(f"| proxy {proxy.get('dur', 0) / 1e3:.2f}")
+        rep = f" r{c['replica']}" if c["replica"] is not None else ""
+        print(f"  {c['rid'][-14:]:>14}{rep}: "
+              f"{c['latency_us'] / 1e3:9.2f} ms  "
+              f"[ms: {' -> '.join(parts)}]")
+
+    # Slowest-replica attribution: in a P2C fleet every replica sees
+    # comparable traffic, so a replica whose mean dispatch/queue time
+    # stands out is where fleet latency actually goes.
+    per_rep: dict = {}
+    for c in chains:
+        if c["replica"] is None or not c["complete"]:
+            continue
+        row = per_rep.setdefault(
+            c["replica"],
+            {s: [0.0, 0] for s in _SERVE_SEGMENTS + ("latency",)},
+        )
+        row["latency"][0] += c["latency_us"]
+        row["latency"][1] += 1
+        for seg in _SERVE_SEGMENTS:
+            ev = c["spans"].get(seg)
+            if ev is not None:
+                row[seg][0] += ev.get("dur", 0)
+                row[seg][1] += 1
+    if len(per_rep) >= 2:
+        segs = _SERVE_SEGMENTS + ("latency",)
+        print("\nslowest-replica attribution (mean ms per segment):")
+        print(f"  {'replica':>8} {'chains':>7} "
+              + "".join(f"{s:>11}" for s in segs))
+        means: dict = {}
+        for rep in sorted(per_rep):
+            row = per_rep[rep]
+            means[rep] = {
+                s: (row[s][0] / row[s][1] / 1e3 if row[s][1] else 0.0)
+                for s in segs
+            }
+            print(f"  {rep:>8} {row['latency'][1]:>7} "
+                  + "".join(f"{means[rep][s]:>11.2f}" for s in segs))
+        for s in segs:
+            worst = max(means, key=lambda r: means[r][s])
+            if means[worst][s] > 0:
+                print(f"  slowest {s:10}: replica {worst} "
+                      f"({means[worst][s]:.2f} ms mean)")
+    return 0
+
+
 def trace_mode(paths: list, out: str, limit: int) -> int:
     events, notes, per_file = merge_traces(paths)
     if not events:
@@ -838,6 +1006,21 @@ _DIRECTION_OVERRIDES = {
     "serve.inflight": None,
     "serve.canary_promotions": None, "serve.canary_rollbacks": None,
     "serve.replicas": None, "serve.replicas_healthy": None,
+    # Fleet observability (ISSUE 14): the SLO burn rate regresses when
+    # it RISES (the error budget is burning faster), as do respawns
+    # (managed replicas are dying), dropped trace events (the trace
+    # lies by omission) and the sampled-tracing overhead ratio (off/on
+    # qps, same shape as trace_overhead); fleet_scrape_ms is the
+    # router's scrape-sweep cost.  Staleness fluctuates with the
+    # scrape cadence — informational, not gated.
+    "serve_burn_rate": "low", "serve.burn_rate": "low",
+    "serve_respawns": "low", "serve.respawns": "low",
+    "serve_trace_dropped": "low",
+    "serve_trace_overhead": "low",
+    "fleet_scrape_ms": "low",
+    "serve_slo_bad_frac": "low", "serve.slo_bad_frac": "low",
+    "serve.fleet_scrape_age_max_s": None,
+    "serve.slo_good": None, "serve.slo_bad": None,
     # Canary shadow-score distribution keys (serve/router.py writes
     # them as bench-style JSONs): the canary gate flags a DRIFT in
     # EITHER direction — "both" is the two-sided direction compare_mode
@@ -920,7 +1103,9 @@ def _comparable_metrics(path: str) -> dict:
     # keys — same shared-set back-compat as the resource block.
     for key in ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_fill",
                 "requests", "swaps", "compiles", "steady_compiles",
-                "recompiles_unexpected"):
+                "recompiles_unexpected", "shed", "shed_frac",
+                "burn_rate", "slo_bad_frac", "respawns", "evictions",
+                "retries"):
         val = (final.get("serve") or {}).get(key)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             out[f"serve.{key}"] = float(val)
@@ -1050,6 +1235,13 @@ def main(argv=None) -> int:
                     help="treat paths as Chrome-trace span files: merge "
                          "onto one timeline and print the critical-path "
                          "summary")
+    ap.add_argument("--serve-trace", action="store_true",
+                    dest="serve_trace",
+                    help="treat paths as SERVING trace files (router + "
+                         "trace_file.replicaN family): per-request "
+                         "critical-path breakdown (admit -> queue -> "
+                         "coalesce -> dispatch -> respond) with "
+                         "slowest-replica attribution")
     ap.add_argument("-o", "--out", default=None,
                     help="--trace: merged trace output path (default "
                          "<first>.merged.json)")
@@ -1064,6 +1256,8 @@ def main(argv=None) -> int:
                          "ingest_wait_frac=0.10 --threshold "
                          "default=0.05")
     args = ap.parse_args(argv)
+    if args.serve_trace:
+        return serve_trace_mode(args.paths, args.out, args.limit)
     if args.trace:
         return trace_mode(args.paths, args.out, args.limit)
     if args.compare:
