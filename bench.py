@@ -257,6 +257,28 @@ def build_trainer_with_ladder(make_cfg, trainer_cls, smoke_steps=2,
     return None, None, None, errors
 
 
+def _bench_quality_identity() -> float:
+    """Self-skew floor of the quality plane: sketch two independent
+    draws of the SAME synthetic example distribution (ids, values,
+    lengths, scores) and report their psi_max.  The debiased PSI must
+    read ~0 — `report.py --compare` gates it low, so any future sketch
+    or PSI change that starts seeing drift in identical data flags."""
+    from fast_tffm_tpu import obs
+
+    rng = np.random.default_rng(7)
+    ref, live = obs.SketchSet(), obs.SketchSet()
+    for sk in (ref, live):
+        for _ in range(64):
+            ids = rng.integers(0, 1 << 20, size=(256, 16))
+            vals = np.where(
+                rng.random((256, 16)) < 0.8,
+                rng.lognormal(size=(256, 16)), 0.0
+            )
+            sk.update_batch(ids, vals)
+            sk.update_scores(rng.random(256))
+    return round(float(live.psi_vs(ref).get("psi_max", 0.0)), 6)
+
+
 def _bench_step_only(trainer, cfg, steps: int) -> float:
     rng = np.random.default_rng(0)
     batches = [trainer._put(_make_batch(rng, cfg, cfg.vocabulary_size))
@@ -344,7 +366,7 @@ def _bench_parse_only(files, cfg) -> float:
 def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
                k: int = 1, telemetry_enabled: bool = True,
                tracer=None, status: bool = False,
-               resource: bool = False) -> tuple:
+               resource: bool = False, quality: bool = False) -> tuple:
     """Examples/sec through BatchPipeline + DevicePrefetcher — the
     train() hot path: parse threads, the stacking/H2D transfer thread,
     and the K-step fused dispatch all overlapped.  ``warmup`` counts
@@ -386,6 +408,16 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     resource plane's live sampling at an aggressive heartbeat-like
     cadence (the AOT dispatch path itself is already in the baseline:
     the trainer's cfg has resource_metrics on by default).
+
+    ``quality=True`` attaches the model-quality plane's full run-time
+    work: the parse-path drift sketches (StreamSketch on the pipeline)
+    and the windowed online-eval monitor consuming each dispatch's
+    scores one dispatch delayed, exactly like train() — the
+    quality-overhead probe.  The scan's score EMISSION is in the
+    baseline too (the bench trainer's cfg has quality on by default);
+    it is one [K, B] store whose bitwise-no-op-ness the parity tests
+    pin, so the on/off ratio here measures the part that does real
+    work: sketch updates + the window statistics + the extra D2H.
     """
     import threading
 
@@ -449,6 +481,15 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
         scraper = threading.Thread(target=_scrape, daemon=True)
         scraper.start()
     tracer = tracer if tracer is not None else obs.NULL_TRACER
+    qual_mon = None
+    qual_sketch = None
+    pending_q = None
+    if quality:
+        qual_sketch = obs.StreamSketch(cfg.quality_window)
+        qual_mon = obs.QualityMonitor(
+            loss_type=cfg.loss_type, window=cfg.quality_window,
+            sketch=qual_sketch,
+        )
     t_wait = tel.timer("train.wait_input")
     t_disp = tel.timer("train.dispatch")
     # The dataset (not epochs) bounds the cache: size the budget to hold
@@ -465,6 +506,7 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
         prestack_k=k,
         telemetry=tel,
         tracer=tracer,
+        quality=qual_sketch,
     )
 
     # Real-example counts ride the host stack (transfer thread), keeping
@@ -531,6 +573,26 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
                 trainer.state = trainer._scan_train_step(trainer.state, sb)
             sb_i += 1
             n += n_real
+            if qual_mon is not None and getattr(
+                trainer, "_with_scores", False
+            ):
+                # The trainer's one-dispatch-delayed quality feed,
+                # reproduced: async D2H this dispatch's scores, consume
+                # the previous dispatch's.
+                arrs = (trainer._last_scores, sb.labels, sb.weights)
+                for a in arrs:
+                    try:
+                        a.copy_to_host_async()
+                    except Exception:  # noqa: BLE001 - backend drift
+                        pass
+                if pending_q is not None:
+                    qual_mon.observe(
+                        np.asarray(pending_q[0]),
+                        np.asarray(pending_q[1]),
+                        np.asarray(pending_q[2]),
+                    )
+                    qual_mon.block()
+                pending_q = arrs
         _drain(trainer.state)
         dt = time.perf_counter() - t0
     finally:
@@ -1500,6 +1562,7 @@ def main() -> int:
     e2e_trace_on, trace_events = 0.0, 0
     e2e_status_on = 0.0
     e2e_resource_on = 0.0
+    e2e_quality_on = 0.0
     bench_compile_s = 0.0
     bf16_rung, bf16_errors = None, []
     e2e_err = None
@@ -1701,6 +1764,20 @@ def main() -> int:
                         ladder_errors.append(
                             f"resource probe: {type(e).__name__}: {e}"
                         )
+                    # Model-quality overhead probe (ISSUE 15, same
+                    # paired shape): the identical K=8 e2e with the
+                    # parse-path drift sketches + windowed online-eval
+                    # monitor attached.  quality_overhead = off/on
+                    # rate ratio; budget <= 1.05 like every obs layer.
+                    try:
+                        e2e_quality_on, _, _, _, _ = _bench_e2e(
+                            trainer, cfg, files, warmup=4,
+                            epochs=epochs, k=K, quality=True,
+                        )
+                    except Exception as e:  # noqa: BLE001 - report only
+                        ladder_errors.append(
+                            f"quality probe: {type(e).__name__}: {e}"
+                        )
                     # Compile-sentinel attribution for the BENCH JSON:
                     # total train-step compile wall time this bench's
                     # trainer paid (the AOT cache makes it exact).
@@ -1869,6 +1946,20 @@ def main() -> int:
         "resource_overhead": round(
             e2e_rate / e2e_resource_on, 4
         ) if e2e_resource_on > 0 and e2e_rate > 0 else 0.0,
+        # Model-quality overhead: the same K=8 e2e with drift sketches
+        # on the parse path + the windowed online-eval monitor
+        # consuming every dispatch's scores.  off/on rate ratio,
+        # budget <= 1.05 — sketch updates are batch-cadence numpy and
+        # the window stats are memoized.
+        "e2e_quality_on_examples_per_sec": round(e2e_quality_on, 1),
+        "quality_overhead": round(
+            e2e_rate / e2e_quality_on, 4
+        ) if e2e_quality_on > 0 and e2e_rate > 0 else 0.0,
+        # Sketch/PSI correctness floor: two independent samples of the
+        # SAME synthetic distribution through the full SketchSet + PSI
+        # machinery must read ~0 (the debiased identity).  A rise here
+        # is a sketch regression, not a data change.
+        "quality_psi_identity": _bench_quality_identity(),
         # Memory & compile attribution of the bench process itself:
         # peak RSS over the whole bench (epoch caches + staged input +
         # jit artifacts), and the train-step compile seconds the AOT

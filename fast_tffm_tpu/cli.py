@@ -167,6 +167,20 @@ def build_argparser() -> argparse.ArgumentParser:
              "without overwriting the checkpoint",
     )
     p.add_argument(
+        "--no_quality", action="store_true",
+        help="disable the model-quality & data-drift plane: no "
+             "distribution sketches on the parse/serve paths, no "
+             "windowed online eval or `quality` record block, no "
+             "manifest sketch payload or serving skew detection "
+             "(bitwise-identical training, byte-identical serving)",
+    )
+    p.add_argument(
+        "--quality_window", type=int, default=None,
+        help="examples per quality window: the drift sketches' "
+             "rotation cadence (PSI compares adjacent windows) and "
+             "the online-eval ring size",
+    )
+    p.add_argument(
         "--no_resource_metrics", action="store_true",
         help="disable the resource plane: no RSS/component-memory "
              "ledger, no compile sentinel (the train step dispatches "
@@ -324,13 +338,15 @@ def main(argv=None) -> int:
                     "serve_shed_deadline_ms", "serve_canary",
                     "serve_transport", "serve_trace_sample",
                     "serve_slo_p99_ms", "serve_slo_availability",
-                    "metrics_file")
+                    "quality_window", "metrics_file")
         if getattr(args, key) is not None
     }
     if args.no_telemetry:
         overrides["telemetry"] = False
     if args.no_resource_metrics:
         overrides["resource_metrics"] = False
+    if args.no_quality:
+        overrides["quality"] = False
     if args.no_serve_canary:
         overrides["serve_canary"] = False
     cfg = load_config(args.cfg, overrides or None)

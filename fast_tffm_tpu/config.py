@@ -272,6 +272,31 @@ class FmConfig:
     # resource block, the historical jit dispatch path — bit-identical
     # training, same contract as every other obs knob.
     resource_metrics: bool = True
+    # Model-quality & data-drift observability (obs/quality.py): the
+    # plane that watches the MODEL where telemetry/resource watch the
+    # system.  On (default): parse workers maintain fixed-memory
+    # distribution sketches over feature values / example lengths /
+    # id occupancy (obs/sketch.py; process workers ship deltas back
+    # like parse timings), the trainer computes windowed online eval
+    # (rolling logloss / AUC / calibration ratio from its own
+    # scores+labels, consumed one-dispatch-delayed like the health
+    # monitors) and adjacent-window PSI drift signals — all riding
+    # heartbeat/final/train-results as a `quality` block resolvable by
+    # alert_rules (e.g. "quality.psi_values > 0.2 for 3 : warn") —
+    # and every save publishes the cumulative sketches into
+    # serve_manifest.json so the serving fleet can detect
+    # training->serving skew (the serve block's `skew_*` keys /
+    # tffm_serve_skew_* series).  Off: no sketches, no scores readback,
+    # no quality block, no manifest payload — bitwise-identical
+    # training and byte-identical serving (pinned by test, same
+    # contract as telemetry/trace/resource).
+    quality: bool = True
+    # Examples per quality window: the rotation cadence of the drift
+    # sketches (PSI compares adjacent windows) AND the size of the
+    # online-eval ring (windowed logloss/AUC describe the most recent
+    # this-many examples).  Smaller = faster drift detection, noisier
+    # statistics.
+    quality_window: int = 65536
     # Windowed trace rotation: when the tracer's buffer reaches this
     # many events it dumps and resets, producing trace.0.json,
     # trace.1.json, ... (merge with tools/report.py --trace) — removes
@@ -429,6 +454,16 @@ class FmConfig:
             raise ValueError(
                 f"status_port must be in [0, 65535], got {self.status_port}"
             )
+        if self.quality_window < 32:
+            # 32 == obs.quality._MIN_PSI_EXAMPLES (pinned equal by
+            # test): below it no window ever reaches judgeable mass,
+            # so the PSI drift signals would silently never appear —
+            # the inert-knob hazard, failed loudly at startup instead.
+            raise ValueError(
+                "quality_window must be >= 32 (windows below the "
+                "minimum judgeable mass would silently disable the "
+                f"PSI drift signals), got {self.quality_window}"
+            )
         if self.trace_rotate_events < 0:
             raise ValueError(
                 "trace_rotate_events must be >= 0, got "
@@ -475,6 +510,27 @@ class FmConfig:
                         "heartbeat would carry no resource block and "
                         "these rules could never fire; enable "
                         "resource_metrics or drop the rules"
+                    )
+            # And again for the model-quality plane: a drift rule
+            # (quality.psi_values, logloss_drift, calib_ratio) — or a
+            # serving skew rule (serve.skew_*), whose keys only exist
+            # when the skew monitor does — is non-evaluable on every
+            # beat when quality=off.
+            if not self.quality:
+                inert = [
+                    r.signal for r in rules
+                    if resolved_signal(r.signal).startswith("quality.")
+                    or resolved_signal(r.signal).startswith(
+                        "serve.skew_"
+                    )
+                ]
+                if inert:
+                    raise ValueError(
+                        f"alert_rules watch quality-plane signals "
+                        f"{inert} but quality is off — the records "
+                        "would carry no quality block / skew keys and "
+                        "these rules could never fire; enable quality "
+                        "or drop the rules"
                     )
         if not 0 <= self.serve_port < 65536:
             raise ValueError(
@@ -696,6 +752,8 @@ _KEYMAP = {
     "status_host": ("status_host", str),
     "alert_rules": ("alert_rules", str),
     "resource_metrics": ("resource_metrics", _parse_bool),
+    "quality": ("quality", _parse_bool),
+    "quality_window": ("quality_window", int),
     "trace_rotate_events": ("trace_rotate_events", int),
     "max_features": ("max_features", int),
     "mesh_data": ("mesh_data", int),

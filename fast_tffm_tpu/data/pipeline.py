@@ -418,6 +418,7 @@ class BatchPipeline:
         epoch_marks: bool = False,
         telemetry: Optional[obs.Telemetry] = None,
         tracer: Optional[obs.Tracer] = None,
+        quality: Optional["obs.StreamSketch"] = None,
     ):
         self.files = list(files)
         # Telemetry instruments (obs.NULL when not passed: every call
@@ -431,6 +432,16 @@ class BatchPipeline:
         # work-item ``seq`` to the delivered ``batch`` index — the join
         # key the prefetcher's super-batch grouping continues from.
         self.tracer = tracer if tracer is not None else obs.NULL_TRACER
+        # Model-quality drift sketches (obs.StreamSketch, None = off):
+        # maintained ON the parse path — thread workers fold each
+        # parsed batch in directly (the accumulator locks internally),
+        # process workers keep a local SketchSet and ship serialized
+        # deltas back on their result messages exactly like parse
+        # timings.  Cached replay epochs re-deliver epoch-0 batches and
+        # are deliberately NOT re-sketched: a replay's distribution is
+        # epoch 0's by construction, so re-adding it would only inflate
+        # counts without moving any distribution.
+        self._quality = quality
         # seq of the batch most recently yielded by the streaming core
         # (generator chains are synchronous, so at the __iter__ exit this
         # names exactly the item that just bubbled up); None for cached
@@ -1034,6 +1045,27 @@ class BatchPipeline:
                         if tracing:
                             tracer.emit("parse.batch", t0p, dtp,
                                         args={"seq": seq})
+                    if self._quality is not None:
+                        # Drift sketches ride the parse threads (batch
+                        # cadence, lock inside the accumulator) so the
+                        # delivery path pays nothing.  Guarded: a
+                        # sketching failure is an OBSERVER failure —
+                        # it degrades the quality plane, it must never
+                        # surface through the worker's fatal error
+                        # path and kill the training it observes.
+                        try:
+                            self._quality.update_batch(
+                                batch.ids, batch.vals, batch.weights
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            self._quality = None  # degrade for good
+                            log.warning(
+                                "quality sketching disabled: "
+                                "update_batch failed (%s: %s); "
+                                "training continues without ingest "
+                                "drift sketches",
+                                type(e).__name__, e,
+                            )
                 except BaseException as e:
                     out.put(_Error(e))
                     continue
@@ -1157,6 +1189,10 @@ class BatchPipeline:
             ring_slots=cfg.ring_slots,
             ring_slot_bytes=ring.slot_bytes if ring is not None else 0,
             trace=self.tracer.enabled,
+            sketch_every=(
+                procpool.SKETCH_SHIP_EVERY
+                if self._quality is not None else 0
+            ),
         )
         procs = [
             ctx.Process(
@@ -1311,24 +1347,39 @@ class BatchPipeline:
                 if kind == "done":
                     expect_done -= 1
                     # Trailing span shipment: worker events that ended
-                    # after its last batch (e.g. the final window span).
+                    # after its last batch (e.g. the final window span)
+                    # — and the worker's final quality-sketch delta
+                    # (batches sketched since its last periodic ship).
                     if len(msg) > 1:
                         tracer.add_raw(msg[1])
+                    if (
+                        len(msg) > 2 and msg[2] is not None
+                        and self._quality is not None
+                    ):
+                        self._quality.absorb(msg[2])
                     continue
                 if kind == "err":
                     raise msg[1]
                 if kind == "mark":
                     seq, obj = msg[1], EpochEnd(msg[2])
-                else:  # ("batch", seq, shm, meta, trunc, note, t, spans)
+                else:  # ("batch", seq, shm, meta, trunc, note, t,
+                    #    spans, sketch_delta)
                     seq = msg[1]
                     obj = procpool.attach_batch(spec, msg[2], msg[3])
                     self._trunc_extra += msg[4]
                     self._log_worker_note(msg[5])
                     # Workers can't reach this process's registry; they
                     # ship their parse wall time with each batch instead
-                    # — and their trace spans the same way.
+                    # — and their trace spans and quality-sketch deltas
+                    # the same way (deltas every SKETCH_SHIP_EVERY
+                    # batches; None in between).
                     self._t_parse.observe(msg[6])
                     tracer.add_raw(msg[7])
+                    if (
+                        len(msg) > 8 and msg[8] is not None
+                        and self._quality is not None
+                    ):
+                        self._quality.absorb(msg[8])
                 if not self.ordered:
                     self._last_seq = seq
                     yield obj
@@ -1397,6 +1448,14 @@ class BatchPipeline:
                 "vocabulary_size is wrong — the device-sort path will "
                 "silently drop updates for ids >= vocabulary_size", msg,
             )
+        elif kind == "sketch_failed":
+            if not getattr(self, "_sketch_warned", False):
+                self._sketch_warned = True
+                log.warning(
+                    "quality sketching failed in a parse worker (%s); "
+                    "that worker's drift feed is disabled, training "
+                    "continues", msg,
+                )
         elif not self._sort_meta_warned:
             self._sort_meta_warned = True
             log.warning(

@@ -60,6 +60,13 @@ _SHM_DIR = "/dev/shm"
 _pipe_ids = itertools.count()
 _ship_ids = itertools.count()
 
+# Quality-sketch shipping cadence (WorkerSpec.sketch_every's one
+# production value): a serialized SketchSet delta is a few KB, so one
+# per batch would undo the ring's descriptor-only queue discipline;
+# one per this-many batches amortizes it to noise while keeping the
+# parent's windows at most this many batches stale per worker.
+SKETCH_SHIP_EVERY = 16
+
 
 def make_shm_tag() -> str:
     """Unique per-pipeline prefix for every segment the pipeline (or its
@@ -89,6 +96,14 @@ class WorkerSpec:
     # and ship them back with each result message; the parent merges
     # them into the run's trace file under this worker's pid lane.
     trace: bool = False
+    # Model-quality drift sketches (obs/sketch.py): > 0 means sketch
+    # every parsed batch's feature values / lengths / id occupancy into
+    # a worker-local SketchSet and ship the serialized DELTA back every
+    # this-many batches (reset after each ship; the final remainder
+    # rides the trailing "done" message) — the parent merges deltas
+    # into the run's StreamSketch, the same channel discipline as the
+    # shipped parse timings.  0 = off (no per-batch sketch work).
+    sketch_every: int = 0
 
 
 _CORE = ("labels", "ids", "vals", "fields", "weights")
@@ -475,8 +490,9 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
 
     Result messages:
       ("batch", seq, shm_name, has_meta, trunc_delta, note, parse_s,
-       spans)
-      ("mark", seq, epoch) | ("err", exc) | ("done", spans)
+       spans, sketch_delta)
+      ("mark", seq, epoch) | ("err", exc) | ("done", spans,
+       sketch_delta)
 
     ``parse_s`` is this batch's parse+prep wall time in the worker — a
     spawned process cannot write to the parent's telemetry registry, so
@@ -487,8 +503,14 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
     ring window — its end marks the slot release) into a local
     obs.Tracer and ships the accumulated raw events with each result;
     the parent merges them into the run's trace under this worker's pid.
-    The trailing ``("done", spans)`` flushes spans that ended after the
-    last batch shipped (the final window span).
+    ``sketch_delta`` (``spec.sketch_every > 0``) is the quality plane's
+    version of the same contract: the worker folds each parsed batch
+    into a local ``obs.sketch.SketchSet`` and ships the serialized
+    delta every ``sketch_every`` batches (None in between; the sketch
+    resets at each ship so the parent absorbs every delta exactly
+    once).  The trailing ``("done", spans, sketch_delta)`` flushes
+    spans that ended after the last batch shipped (the final window
+    span) and the sketch remainder.
     """
     parse_lines, parse_raw, trunc = _build_parser(spec)
     from fast_tffm_tpu.obs.trace import Tracer
@@ -496,6 +518,12 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
     tracer = Tracer(
         enabled=spec.trace, process_name=f"parse-worker {os.getpid()}"
     )
+    sketch = None
+    sketch_pending = 0
+    if spec.sketch_every > 0:
+        from fast_tffm_tpu.obs.sketch import SketchSet
+
+        sketch = SketchSet()
     meta_spec = spec.sort_meta_spec
     ring = None
     if spec.ring_name is not None:
@@ -508,7 +536,7 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
 
     def emit(batch: Batch, seq: int, trunc_delta: int,
              parse_s: float) -> bool:
-        nonlocal meta_spec
+        nonlocal meta_spec, sketch, sketch_pending
         note = None
         has_meta = False
         if meta_spec is not None:
@@ -527,9 +555,31 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
                 note = ("meta_failed", f"{type(e).__name__}: {e}")
             # sort prep is parse-stage work; fold it into the shipped time
             parse_s += time.perf_counter() - t0
+        delta = None
+        if sketch is not None:
+            # Guarded like the thread path: sketching is observe-only,
+            # so a failure degrades this worker's quality feed (note
+            # shipped once; the parent warns) — it must never become
+            # an ("err", ...) that kills the run.
+            try:
+                sketch.update_batch(
+                    batch.ids, batch.vals, batch.weights
+                )
+                sketch_pending += 1
+                if sketch_pending >= spec.sketch_every:
+                    from fast_tffm_tpu.obs.sketch import SketchSet
+
+                    delta = sketch.to_dict()
+                    sketch = SketchSet()
+                    sketch_pending = 0
+            except Exception as e:  # noqa: BLE001 - observe only
+                sketch = None  # this worker degrades for good
+                if note is None:
+                    note = ("sketch_failed",
+                            f"{type(e).__name__}: {e}")
         shm_name = ship_batch(spec, batch, has_meta)
         if put(("batch", seq, shm_name, has_meta, trunc_delta, note,
-                parse_s, tracer.take())):
+                parse_s, tracer.take(), delta)):
             return True
         # Teardown raced the ship: the segment is already unregistered
         # from this worker's tracker and nobody will ever attach it —
@@ -543,7 +593,11 @@ def parse_worker_main(spec: WorkerSpec, work, out, stop,
         except _queue.Empty:
             continue
         if msg is None:
-            put(("done", tracer.take()))
+            put((
+                "done", tracer.take(),
+                sketch.to_dict()
+                if sketch is not None and sketch_pending else None,
+            ))
             return
         try:
             kind = msg[0]

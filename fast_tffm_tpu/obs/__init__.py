@@ -23,7 +23,11 @@ from fast_tffm_tpu.obs.alerts import (
     parse_rules, run_until_halt,
 )
 from fast_tffm_tpu.obs.heartbeat import Heartbeat, JsonlWriter
+from fast_tffm_tpu.obs.quality import (
+    QualityMonitor, ServeSkewMonitor, StreamSketch,
+)
 from fast_tffm_tpu.obs.resource import CompileSentinel, read_rss
+from fast_tffm_tpu.obs.sketch import FreqSketch, QuantileSketch, SketchSet
 from fast_tffm_tpu.obs.status import StatusServer, render_prometheus
 from fast_tffm_tpu.obs.telemetry import (
     NULL, Counter, DepthHist, Gauge, Telemetry, Timing, trace_span,
@@ -37,4 +41,6 @@ __all__ = [
     "AlertEngine", "AlertHaltError", "AlertRule", "halt_error",
     "parse_rules", "run_until_halt",
     "CompileSentinel", "read_rss",
+    "FreqSketch", "QuantileSketch", "SketchSet",
+    "QualityMonitor", "ServeSkewMonitor", "StreamSketch",
 ]

@@ -108,6 +108,14 @@ _ALIASES = {
     # eviction count, and fleet-scrape staleness — the one-line-rule
     # signals a serving operator pages on (OBSERVABILITY.md "Serving
     # SLO & burn rate").
+    # Model-quality plane (the heartbeat's `quality` block,
+    # obs/quality.py): the drift signals a modeling operator writes
+    # one-line rules for — windowed-logloss drift vs its rolling
+    # baseline, the calibration ratio, and the worst adjacent-window
+    # PSI across the sketched axes.
+    "logloss_drift": "quality.logloss_drift",
+    "calib_ratio": "quality.calib_ratio",
+    "psi_max": "quality.psi_max",
     "burn_rate": "serve.burn_rate",
     "slo_bad_frac": "serve.slo_bad_frac",
     "shed_frac": "serve.shed_frac",

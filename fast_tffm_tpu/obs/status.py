@@ -117,7 +117,8 @@ def render_prometheus(record: dict) -> str:
     - ``stages.timers`` -> ``tffm_timer_<name>_count`` /
       ``_seconds_total`` counters + ``_p50_ms``/``_p95_ms``/``_p99_ms``
       /``_max_ms``/``_mean_ms`` gauges (the percentiles describe the
-      recent ring — see telemetry.Timing);
+      recent ring — see telemetry.Timing) + the ``_window_count``
+      gauge naming how many ring samples those percentiles summarize;
     - ``stages.depths`` -> ``tffm_depth_<name>_events_total`` /
       ``_mean`` / ``_max`` plus per-band ``_bucket{band="1-3"}`` gauges
       (occupancy bands, not cumulative ``le`` buckets);
@@ -127,7 +128,10 @@ def render_prometheus(record: dict) -> str:
       byte ledger, compile counters, FLOPs attribution);
     - ``serve.*`` -> ``tffm_serve_<key>`` gauges (qps, latency
       percentiles, batch fill, steady_compiles — the serving
-      endpoint's record block);
+      endpoint's record block, including the ``skew_*`` keys as
+      ``tffm_serve_skew_*``);
+    - ``quality.*`` -> ``tffm_quality_<key>`` gauges (windowed online
+      eval + drift signals — the model-quality record block);
     - ``build_info`` (a dict of strings) -> one ``tffm_build_info``
       info-style gauge whose LABELS carry the run identity (jax
       version, backend, mesh, K), value always 1 — the Prometheus
@@ -157,6 +161,11 @@ def render_prometheus(record: dict) -> str:
         base = f"tffm_timer_{_prom_name(name)}"
         emit(f"{base}_count", snap.get("count", 0), "counter")
         emit(f"{base}_seconds_total", snap.get("total_s", 0.0), "counter")
+        if "window_n" in snap:
+            # Sample-count companion of the percentile gauges: how many
+            # ring samples p50/p95/p99 summarize — a p99 over 3 samples
+            # must be distinguishable from one over 30k.
+            emit(f"{base}_window_count", snap["window_n"])
         for pkey in ("mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
             if pkey in snap:
                 emit(f"{base}_{pkey}", snap[pkey])
@@ -172,7 +181,7 @@ def render_prometheus(record: dict) -> str:
             lines.append(f"# TYPE {base}_bucket gauge")
             for band, n in buckets.items():
                 lines.append(f'{base}_bucket{{band="{band}"}} {n}')
-    for block in ("health", "tiered", "resource", "serve"):
+    for block in ("health", "tiered", "resource", "serve", "quality"):
         for key, val in sorted((record.get(block) or {}).items()):
             emit(f"tffm_{block}_{_prom_name(key)}", val)
     info = record.get("build_info")

@@ -158,6 +158,12 @@ class Timing:
         p99 = window[int(0.99 * (n - 1))] if n else 0.0
         return {
             "count": count,
+            # How many samples the percentiles below actually describe
+            # (the ring, not the run): a p99 over 3 samples and one
+            # over 30k are different claims, and only this number
+            # distinguishes them — rendered as the `_window_count`
+            # companion of every percentile series on /metrics.
+            "window_n": n,
             "total_s": round(total, 6),
             "mean_ms": round(1e3 * total / count, 4),
             "p50_ms": round(1e3 * p50, 4),

@@ -86,11 +86,18 @@ class ServeBatcher:
 
     def __init__(self, scorer, max_batch_wait_ms: float = 2.0,
                  queue_size: int = 1024, telemetry=None, tracer=None,
-                 slo=None):
+                 slo=None, quality=None):
         self._scorer = scorer
         self._wait_s = max(0.0, float(max_batch_wait_ms)) / 1e3
         self._tracer = tracer if tracer is not None else NULL_TRACER
         self._slo = slo
+        # Training→serving skew monitor (obs.ServeSkewMonitor, None =
+        # quality off): the dispatcher folds every scored request's
+        # feature arrays + served scores into the live traffic sketch
+        # AFTER the scores are delivered — pure observation on the
+        # dispatcher thread, so responses are byte-identical with it
+        # on or off (pinned by test).
+        self._quality = quality
         tel = telemetry if telemetry is not None else obs.NULL
         self._c_requests = tel.counter("serve.requests")
         self._c_examples = tel.counter("serve.examples")
@@ -302,6 +309,39 @@ class ServeBatcher:
                     self._outstanding.discard(g)
                     self._g_inflight.set(len(self._outstanding))
                 g.event.set()
+            if self._quality is not None:
+                # Skew sketching AFTER every waiter is released: the
+                # request's own (unpadded) arrays and its served
+                # scores — never the pool buffer, whose padded tail
+                # would dilute the length/id distributions.  Its own
+                # except: these requests were already ANSWERED, so a
+                # sketching failure must not re-enter the outer
+                # fail-the-clients handler (which would stamp errors
+                # on delivered requests and double-count the SLO
+                # window).
+                try:
+                    # ONE fold per dispatched group (concatenating the
+                    # unpadded request arrays), not one per request:
+                    # the dispatcher is serial, and per-request lock
+                    # round-trips would add straight to the next
+                    # group's queueing latency under many-small-
+                    # request traffic.
+                    if len(group) == 1:
+                        g = group[0]
+                        self._quality.observe_batch(g.ids, g.vals)
+                        self._quality.observe_scores(g.scores)
+                    else:
+                        self._quality.observe_batch(
+                            np.concatenate([g.ids for g in group]),
+                            np.concatenate([g.vals for g in group]),
+                        )
+                        self._quality.observe_scores(
+                            np.concatenate(
+                                [g.scores for g in group]
+                            )
+                        )
+                except Exception as e:  # noqa: BLE001 - observe only
+                    log.warning("skew sketching failed: %s", e)
         except BaseException as e:  # noqa: BLE001 - fail the CLIENTS
             log.warning("serve dispatch failed: %s", e)
             for g in group:

@@ -1186,6 +1186,7 @@ class ServeRouter:
         ("batch_fill", "tffm_serve_replica_batch_fill", "gauge"),
         ("steady_compiles", "tffm_serve_replica_steady_compiles",
          "gauge"),
+        ("skew_psi_max", "tffm_serve_replica_skew_psi_max", "gauge"),
     )
 
     def _fleet_aggregates(self, per: list, scrapes: dict,
@@ -1238,6 +1239,28 @@ class ServeRouter:
             out["fleet_batch_fill"] = round(
                 sum(fills) / len(fills), 6
             )
+        # Training→serving skew (the replicas' skew_* keys,
+        # obs/quality.py): MAX-merged under the SAME key names, so one
+        # router scrape answers "is ANY replica's traffic skewed" as
+        # the familiar tffm_serve_skew_* series — a per-replica PSI is
+        # already a distribution distance, and the fleet's worst one is
+        # the honest aggregate (means would dilute a single skewed
+        # replica N-fold).  skew_examples sums (it is mass, not
+        # distance).
+        for key in ("skew_psi_values", "skew_psi_lengths",
+                    "skew_psi_ids", "skew_psi_scores", "skew_psi_max"):
+            vals = [
+                b.get(key) for (_, b), _i in blocks
+                if isinstance(b.get(key), (int, float))
+            ]
+            if vals:
+                out[key] = round(max(vals), 6)
+        skew_n = [
+            b.get("skew_examples") for (_, b), _i in blocks
+            if isinstance(b.get("skew_examples"), (int, float))
+        ]
+        if skew_n:
+            out["skew_examples"] = int(sum(skew_n))
         out["fleet_scrape_age_max_s"] = round(
             max(now - t for (t, _b), _i in blocks), 3
         )

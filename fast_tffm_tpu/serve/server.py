@@ -238,7 +238,8 @@ class ServeServer:
     def __init__(self, port: int, batcher: ServeBatcher, cfg: FmConfig,
                  build, telemetry=None, host: str = "127.0.0.1",
                  timeout_s: float = 30.0, scorer=None, tracer=None,
-                 sampler=None, slo=None):
+                 sampler=None, slo=None, on_reload=None,
+                 on_rollback=None):
         tel = telemetry if telemetry is not None else obs.NULL
         tracer = tracer if tracer is not None else NULL_TRACER
         # Request-id mint + trace-sampling coin flip for DIRECT
@@ -413,8 +414,17 @@ class ServeServer:
                                 cfg, scorer,
                                 keep_prev="keep_prev=1" in query,
                             )
+                            if on_reload is not None:
+                                # The served params now come from the
+                                # current manifest; the skew reference
+                                # follows (canary replicas run
+                                # watcher-less, so this is their only
+                                # reference-refresh path).
+                                on_reload()
                         elif path == "/promote":
                             scorer.promote()
+                            if on_reload is not None:
+                                on_reload()
                         else:
                             if not scorer.rollback():
                                 self._send(
@@ -423,6 +433,13 @@ class ServeServer:
                                     "text/plain",
                                 )
                                 return
+                            if on_rollback is not None:
+                                # The served params just reverted to
+                                # the PRE-canary checkpoint; the skew
+                                # reference reverts with them (its
+                                # manifest is gone from disk, so this
+                                # restores the stashed copy).
+                                on_rollback()
                     except ValueError as e:
                         self._send(
                             409, f"{e}\n".encode(), "text/plain"
@@ -570,6 +587,14 @@ def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
     for key in ("mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
         if key in lat:
             out[key] = lat[key]
+    if lat.get("count"):
+        # Sample-count companions of the percentile keys above: the
+        # run-total observations and how many ring samples the
+        # percentiles actually summarize (a p99 over 3 requests is a
+        # different claim than one over 30k).
+        out["latency_count"] = int(lat["count"])
+        if "window_n" in lat:
+            out["latency_window_n"] = int(lat["window_n"])
     parse = timers.get("serve.parse") or {}
     if "p50_ms" in parse:
         out["parse_p50_ms"] = parse["p50_ms"]
@@ -605,6 +630,26 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
         cfg.serve_slo_p99_ms, cfg.serve_slo_availability,
         telemetry=telemetry,
     )
+    # Training→serving skew detection (obs/quality.py): live request
+    # sketches judged against the trainer-published reference sketches
+    # in serve_manifest.json; the reference re-reads after every hot
+    # swap so it always matches the checkpoint being served.  quality
+    # off = no monitor, no skew_* keys, byte-identical serving.
+    skew = None
+    if cfg.quality:
+        from fast_tffm_tpu.train.manifest import read_manifest
+
+        def _read_skew_reference(_model=cfg.model_file):
+            man = read_manifest(_model)
+            if not isinstance(man, dict) or "quality" not in man:
+                return None
+            return {"step": man.get("step", -1), **man["quality"]}
+
+        skew = obs.ServeSkewMonitor(
+            window_examples=cfg.quality_window, telemetry=telemetry,
+            read_reference=_read_skew_reference,
+        )
+        skew.reload_reference()
     # Watcher baseline BEFORE the load: a checkpoint published while we
     # load/warm up must look NEW to the first poll (the scorer may or
     # may not have caught it; re-swapping to the same step is a cheap
@@ -632,19 +677,25 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
     batcher = ServeBatcher(
         scorer, max_batch_wait_ms=cfg.max_batch_wait_ms,
         queue_size=cfg.queue_size, telemetry=telemetry, tracer=tracer,
-        slo=slo,
+        slo=slo, quality=skew,
     )
     t0 = time.time()
 
     def build(kind: str = "status"):
         now = time.time()
         wall = max(now - t0, 1e-9)
-        # SLO gauges refresh BEFORE the snapshot so one scrape sees
-        # block keys and gauge spellings agree.
+        # SLO (and skew) gauges refresh BEFORE the snapshot so one
+        # scrape sees block keys and gauge spellings agree.  The final
+        # record forces a fresh skew compute past the TTL memo.
         slo_block = slo.snapshot()
+        skew_block = (
+            skew.block(force=(kind == "final"))
+            if skew is not None else {}
+        )
         snap = telemetry.snapshot()
         serve_block = _serve_block(snap, scorer, batcher, wall)
         serve_block.update(slo_block)
+        serve_block.update(skew_block)
         rec = {
             "record": kind,
             "time": now,
@@ -673,6 +724,7 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             "batch_size": cfg.batch_size,
             "telemetry": cfg.telemetry,
             "heartbeat_secs": cfg.heartbeat_secs,
+            "quality": cfg.quality,
         })
     # Alert watchdog riding the serve heartbeat (same contract as the
     # trainer's: FmConfig guarantees heartbeat_secs > 0 when rules are
@@ -702,12 +754,25 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             watcher = CheckpointWatcher(
                 cfg, scorer, cfg.serve_poll_secs,
                 seen=manifest_baseline,
+                # A hot swap changes the model being served; the skew
+                # reference must follow it to the new manifest.
+                on_swap=(
+                    (lambda step: skew.reload_reference())
+                    if skew is not None else None
+                ),
             )
         server = ServeServer(
             cfg.serve_port if port is None else port,
             batcher, cfg, build, telemetry=telemetry,
             host=cfg.serve_host, scorer=scorer, tracer=tracer,
             slo=slo,
+            on_reload=(
+                skew.reload_reference if skew is not None else None
+            ),
+            on_rollback=(
+                skew.restore_previous_reference
+                if skew is not None else None
+            ),
         )
     except BaseException:
         # A taken port (or watcher failure) must not leak the batcher

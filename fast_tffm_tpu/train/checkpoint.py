@@ -48,6 +48,7 @@ def save(
     params: Any,
     opt_state: Any = None,
     data_state: Optional[dict] = None,
+    manifest_extra: Optional[dict] = None,
 ) -> None:
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(
@@ -90,7 +91,7 @@ def save(
         with open(tmp, "w") as f:
             json.dump(data_state, f)
         os.replace(tmp, _data_state_path(model_file))
-    _publish_manifest(model_file, step, "dense")
+    _publish_manifest(model_file, step, "dense", extra=manifest_extra)
     log.info("saved checkpoint step=%d to %s", step, model_file)
 
 
@@ -156,6 +157,7 @@ def save_tiered(
     scalars: dict,
     stores: dict,
     data_state: Optional[dict] = None,
+    manifest_extra: Optional[dict] = None,
 ) -> None:
     """Sparse-overlay checkpoint for a tiered table too large to merge
     into the dense format (train.tiered): per logical store, the ids and
@@ -201,7 +203,7 @@ def save_tiered(
         with open(dtmp, "w") as f:
             json.dump(data_state, f)
         os.replace(dtmp, _data_state_path(model_file))
-    _publish_manifest(model_file, step, "tiered")
+    _publish_manifest(model_file, step, "tiered", extra=manifest_extra)
     log.info("saved tiered overlay checkpoint step=%d to %s", step, path)
 
 

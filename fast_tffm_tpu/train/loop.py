@@ -143,9 +143,11 @@ def _tree_grad_health(grads):
 def make_train_step(cfg: FmConfig, optimizer, with_health: bool = False):
     """Dense train step (optax): full-table optimizer update each step.
 
-    ``with_health=True`` returns ``(state, (grad_sq, nonfinite))`` —
-    the health aux the scan carry accumulates (the dense path reduces
-    the full gradient pytree it already materialized)."""
+    ``with_health=True`` returns ``(state, (grad_sq, nonfinite),
+    scores)`` — the health aux the scan carry accumulates (the dense
+    path reduces the full gradient pytree it already materialized) plus
+    the step's raw scores, which the quality plane's scan wrapper can
+    emit per-step (make_scan_train_step ``with_scores``)."""
 
     def step(state: TrainState, batch: Batch):
         def loss_fn(params):
@@ -171,7 +173,7 @@ def make_train_step(cfg: FmConfig, optimizer, with_health: bool = False):
         )
         new_state = TrainState(params, opt_state, ms, state.step + 1)
         if with_health:
-            return new_state, _tree_grad_health(grads)
+            return new_state, _tree_grad_health(grads), aux["scores"]
         return new_state
 
     return step
@@ -219,7 +221,7 @@ def make_sparse_train_step(cfg: FmConfig, mesh=None,
         )
         new_state = TrainState(params, opt_state, ms, state.step + 1)
         if with_health:
-            return new_state, out[3]
+            return new_state, out[3], scores
         return new_state
 
     return step
@@ -261,7 +263,8 @@ def make_health_update(cfg: FmConfig):
     return update
 
 
-def make_scan_train_step(step_fn, health_update=None):
+def make_scan_train_step(step_fn, health_update=None,
+                         with_scores: bool = False):
     """Wrap a (state, batch) -> state train step in ``jax.lax.scan`` over
     a stacked super-batch: ONE dispatch trains K steps with zero
     intervening Python/host round-trips (the device-resident hot loop the
@@ -274,14 +277,25 @@ def make_scan_train_step(step_fn, health_update=None):
     retraces per distinct K, so an epoch tail at K' = leftover costs one
     extra compile the first time that K' appears.
 
-    With ``health_update``, ``step_fn`` must return ``(state, aux)`` and
-    the wrapper becomes ``(state, health, batches) -> (state, health)``:
-    a :class:`HealthState` rides the scan carry alongside the TrainState
-    — grad-norm / non-finite / row-touch monitors updated on-device
-    every step, read back by the host only at dispatch boundaries.  The
-    health carry is deliberately NOT donated (it is a separate argument)
-    so the host can keep the previous dispatch's scalars alive for its
-    delayed ``nan_policy`` check without racing buffer donation.
+    With ``health_update``, ``step_fn`` must return ``(state, aux,
+    scores)`` and the wrapper becomes ``(state, health, batches) ->
+    (state, health)``: a :class:`HealthState` rides the scan carry
+    alongside the TrainState — grad-norm / non-finite / row-touch
+    monitors updated on-device every step, read back by the host only
+    at dispatch boundaries.  The health carry is deliberately NOT
+    donated (it is a separate argument) so the host can keep the
+    previous dispatch's scalars alive for its delayed ``nan_policy``
+    check without racing buffer donation.
+
+    ``with_scores=True`` (the quality plane, cfg.quality) additionally
+    stacks each step's raw scores as the scan's ys and returns
+    ``(state, health, scores[K, B])`` — the per-dispatch eval feed the
+    windowed online-eval monitor consumes one dispatch delayed (same
+    async-D2H discipline as the health scalars).  The scores were
+    already computed by every step; emitting them adds one [K, B]
+    store, no math — the carry update is identical either way, so
+    training stays bitwise-identical with the flag off or on (pinned
+    by tests/test_quality.py).
     """
     if health_update is None:
 
@@ -298,10 +312,15 @@ def make_scan_train_step(step_fn, health_update=None):
                          batches: Batch):
         def body(carry, batch):
             s, h = carry
-            s2, aux = step_fn(s, batch)
-            return (s2, health_update(h, s2, batch, aux)), None
+            s2, aux, scores = step_fn(s, batch)
+            carry2 = (s2, health_update(h, s2, batch, aux))
+            return carry2, (scores if with_scores else None)
 
-        (state, health), _ = jax.lax.scan(body, (state, health), batches)
+        (state, health), ys = jax.lax.scan(
+            body, (state, health), batches
+        )
+        if with_scores:
+            return state, health, ys
         return state, health
 
     return scan_health_step
@@ -516,14 +535,33 @@ class Trainer:
         self._health_host: dict = {}  # last host-read health scalars
         self._health_step0 = int(self.state.step)  # run-start step base
         health_sh = jax.tree.map(lambda x: x.sharding, self._health)
+        # Model-quality plane (obs/quality.py): with cfg.quality on,
+        # the fused scan additionally emits each step's scores as the
+        # scan ys — the feed for the windowed online-eval monitor,
+        # consumed one dispatch delayed exactly like the health
+        # scalars.  Multi-host runs skip the eval feed (the per-host
+        # view of a globally sharded score array is partial); the
+        # ingest-side drift sketches still run per host.  The objects
+        # themselves are per-run (created in train()).
+        self._with_scores = bool(cfg.quality) and jax.process_count() == 1
+        self._quality: Optional[obs.QualityMonitor] = None
+        self._quality_sketch: Optional[obs.StreamSketch] = None
+        self._last_scores = None
         # Only the TrainState is donated: the un-donated health arrays
         # let the host keep the PREVIOUS dispatch's nonfinite/grad-norm
         # scalars alive for the delayed nan_policy check (a donated
         # carry would invalidate them under the next dispatch).
+        scan_out_sh = (state_sh, health_sh)
+        if self._with_scores:
+            # ys [K, B] shards like the stacked labels it aligns with.
+            scan_out_sh = scan_out_sh + (self._super_batch_sh.labels,)
         self._scan_health_jit = jax.jit(
-            make_scan_train_step(step_fn_health, make_health_update(dcfg)),
+            make_scan_train_step(
+                step_fn_health, make_health_update(dcfg),
+                with_scores=self._with_scores,
+            ),
             in_shardings=(state_sh, health_sh, self._super_batch_sh),
-            out_shardings=(state_sh, health_sh),
+            out_shardings=scan_out_sh,
             donate_argnums=0,
         )
         # Resource plane (obs/resource.py): the fused-scan dispatch runs
@@ -849,7 +887,12 @@ class Trainer:
             fn = self._compiled_scan(state, batches)
         else:
             fn = self._scan_health_jit
-        state, self._health = fn(state, self._health, batches)
+        if self._with_scores:
+            state, self._health, self._last_scores = fn(
+                state, self._health, batches
+            )
+        else:
+            state, self._health = fn(state, self._health, batches)
         return state
 
     def _compiled_scan(self, state: TrainState, batches: Batch):
@@ -1342,6 +1385,8 @@ class Trainer:
                 "status_port": cfg.status_port,
                 "alert_rules": cfg.alert_rules,
                 "resource_metrics": cfg.resource_metrics,
+                "quality": cfg.quality,
+                "quality_window": cfg.quality_window,
                 "jax_version": jax.__version__,
                 "backend": jax.default_backend(),
                 "mesh": {str(a): int(n) for a, n in self.mesh.shape.items()},
@@ -1401,7 +1446,23 @@ class Trainer:
         }
         if self.tiered is not None:
             self.tiered.reopen()  # re-arm after a cancelled prior run
+        # Model-quality plane, per-run (same reset discipline as
+        # telemetry/tracer/health): the drift-sketch accumulator the
+        # parse workers feed, and the windowed online-eval monitor the
+        # dispatch loop feeds one dispatch delayed.
+        self._quality_sketch = (
+            obs.StreamSketch(cfg.quality_window) if cfg.quality else None
+        )
+        self._quality = (
+            obs.QualityMonitor(
+                loss_type=cfg.loss_type, window=cfg.quality_window,
+                sketch=self._quality_sketch,
+            )
+            if cfg.quality else None
+        )
+        self._last_scores = None
         pending_health = None  # (nonfinite_arr, grad_sq_arr, grad_sq_sum_arr, stepno)
+        pending_quality = None  # (scores_arr, labels_arr, weights_arr)
         nonfinite_warned = False
 
         def check_health(pending) -> None:
@@ -1495,6 +1556,7 @@ class Trainer:
             epoch_marks=True,
             telemetry=self.telemetry,
             tracer=self.tracer,
+            quality=self._quality_sketch,
         )
         # Transfer stage: a background thread stacks K parsed batches
         # and ships super-batch n+1 (shard + device_put) while n trains;
@@ -1588,6 +1650,16 @@ class Trainer:
                 # Hot/cold cache behavior (host-side counters only —
                 # safe from the heartbeat thread).
                 rec["tiered"] = self.tiered.snapshot()
+            if self._quality is not None:
+                # Model-quality self-report: windowed online eval +
+                # drift signals (host-side numpy over the consumed
+                # score window; memoized inside the monitor so scrape
+                # storms don't repeat the window statistics — the
+                # final record forces a fresh compute, its values
+                # must be end-of-run exact).
+                rec["quality"] = self._quality.block(
+                    force=(kind == "final")
+                )
             if self.tracer.enabled:
                 # Truncation truthfulness: a trace that hit the event
                 # cap silently lies by omission; the count rides every
@@ -1747,6 +1819,29 @@ class Trainer:
                     if pending_health is not None:
                         check_health(pending_health)
                     pending_health = (nf_arr, gs_arr, ss_arr, stepno)
+                    # Quality eval feed, same one-dispatch-delayed
+                    # discipline: start an async D2H of THIS dispatch's
+                    # stacked scores (+ the labels/weights the batch
+                    # already holds — the super-batch is not donated, so
+                    # its buffers stay valid), then consume the PREVIOUS
+                    # dispatch's arrays, which are already resident.
+                    if self._quality is not None and self._with_scores:
+                        q_arrs = (
+                            self._last_scores, super_batch.labels,
+                            super_batch.weights,
+                        )
+                        for a in q_arrs:
+                            try:
+                                a.copy_to_host_async()
+                            except Exception:  # pragma: no cover - drift
+                                pass
+                        if pending_quality is not None:
+                            self._quality.observe(
+                                np.asarray(pending_quality[0]),
+                                np.asarray(pending_quality[1]),
+                                np.asarray(pending_quality[2]),
+                            )
+                        pending_quality = q_arrs
                     # Alert halt: the watchdog armed the flag on the
                     # heartbeat thread; raising HERE (between
                     # dispatches) keeps the halt on the main thread —
@@ -1864,6 +1959,15 @@ class Trainer:
                 if pending_health is not None:
                     check_health(pending_health)
                     pending_health = None
+                # ... and the last delayed quality feed, so the final
+                # record's windowed eval covers every dispatched step.
+                if pending_quality is not None and self._quality is not None:
+                    self._quality.observe(
+                        np.asarray(pending_quality[0]),
+                        np.asarray(pending_quality[1]),
+                        np.asarray(pending_quality[2]),
+                    )
+                    pending_quality = None
             finally:
                 if heartbeat is not None:
                     heartbeat.close()
@@ -1971,6 +2075,12 @@ class Trainer:
         if self.tiered is not None:
             train_metrics["tiered"] = dict(
                 self._final_record.get("tiered", {})
+            )
+        if "quality" in self._final_record:
+            # End-of-run windowed eval + drift signals (the model-
+            # quality companion of the health block above).
+            train_metrics["quality"] = dict(
+                self._final_record["quality"]
             )
         self.save(stepno)
         result = {"train": train_metrics}
@@ -2097,6 +2207,24 @@ class Trainer:
             table=jax.device_put(merged[0], rep),
         )
 
+    def _manifest_quality(self) -> Optional[dict]:
+        """The training→serving skew reference: this run's cumulative
+        feature/score sketches, published into ``serve_manifest.json``
+        next to the checkpoint step so serving replicas can compare
+        live request traffic against the distribution the model
+        actually trained on.  None (no manifest key at all) before the
+        first sketched batch or with quality off — a serving fleet
+        reads absence as "no reference", never as an empty one."""
+        sk = self._quality_sketch
+        if sk is None:
+            return None
+        payload = sk.export()
+        if payload is None:
+            return None
+        return {"quality": {
+            "examples": sk.examples, "sketches": payload,
+        }}
+
     def save(self, stepno: int):
         data_state = {
             "epoch": self._epoch,
@@ -2110,6 +2238,7 @@ class Trainer:
                 self.state.params,
                 self.state.opt_state,
                 data_state=data_state,
+                manifest_extra=self._manifest_quality(),
             )
             return
         # Tiered: the checkpoint of record is the LOGICAL table.  Small
@@ -2143,6 +2272,7 @@ class Trainer:
             checkpoint.save(
                 cfg.model_file, step, params, opt_state,
                 data_state=data_state,
+                manifest_extra=self._manifest_quality(),
             )  # checkpoint.save clears any stale overlay itself
             return
         scalars = {"w0": w0, **opt_scalars}
@@ -2150,6 +2280,7 @@ class Trainer:
             cfg.model_file, step, scalars,
             self.tiered.export_overlay(host_tables),
             data_state=data_state,
+            manifest_extra=self._manifest_quality(),
         )
 
 

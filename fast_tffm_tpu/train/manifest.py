@@ -23,13 +23,20 @@ def _manifest_path(model_file: str) -> str:
                         "serve_manifest.json")
 
 
-def _publish_manifest(model_file: str, step: int, fmt: str) -> None:
+def _publish_manifest(model_file: str, step: int, fmt: str,
+                      extra: Optional[dict] = None) -> None:
     """Publish the serving manifest AFTER the checkpoint files land.
 
     ``published`` disambiguates re-saves at the same step (a warm
-    restart that trains zero new steps still republishes).
+    restart that trains zero new steps still republishes).  ``extra``
+    merges additional top-level keys into the document — the trainer
+    passes its ``quality`` sketch payload (the training→serving skew
+    reference the serve fleet compares live traffic against; see
+    OBSERVABILITY.md "Model quality & drift" and SERVING.md).
     """
     doc = {"step": int(step), "format": fmt, "published": time.time()}
+    if extra:
+        doc.update(extra)
     tmp = _manifest_path(model_file) + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f)

@@ -16,7 +16,8 @@ SUBPROCESS (pinned to CPU), not an in-process Trainer — and asserts:
    pipeline's threads;
 4. ``/profile?secs=N`` captures one profiler window mid-run, and its
    busy-guard rejects a CONCURRENT second request with 409;
-5. the run itself exits 0.
+5. the run itself exits 0, and its final record carries a non-empty
+   ``quality`` block (windowed online eval + drift sketches ran).
 
 Then the SERVE smoke (the online scoring path, SERVING.md) against the
 checkpoint that run just wrote — ``run_tffm.py serve`` in a subprocess:
@@ -25,7 +26,11 @@ checkpoint that run just wrote — ``run_tffm.py serve`` in a subprocess:
 7. ``/metrics`` serves the ``tffm_serve_*`` series (Prometheus-valid);
 8. a second short training run into the same model dir republishes the
    checkpoint manifest, and the server HOT-SWAPS exactly as designed
-   (``tffm_counter_serve_swaps_total`` reaches 1) while still scoring.
+   (``tffm_counter_serve_swaps_total`` reaches 1) while still scoring;
+8b. training→serving skew END TO END: identity traffic (lines from
+   the training file) reads stable against the manifest's training
+   sketches, and a shifted request population (foreign ids, 100x
+   values) breaches ``tffm_serve_skew_psi_max`` > 0.25 on /metrics.
 
 Then the ROUTER smoke (scale-out serving, SERVING.md "Scale-out") —
 ``run_tffm.py serve --replicas 2`` in a subprocess, with per-request
@@ -325,9 +330,56 @@ def check_serve(cfg_path: str, data: str) -> None:
         ).read().decode()
         if len(body2.strip().splitlines()) != 10:
             raise SystemExit("FAIL: /score broken after hot-swap")
+        # Training→serving skew, end to end over the socket: identity
+        # traffic (lines from the training file itself) must read
+        # stable against the manifest's training sketches; a shifted
+        # request population (foreign ids, 100x values) must breach
+        # tffm_serve_skew_* — the ISSUE 15 acceptance path.
+        with open(data) as f:
+            identity = "".join(f.readline() for _ in range(200))
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/score", data=identity.encode(), method="POST"
+        ), timeout=30).read()
+        status = json.loads(urllib.request.urlopen(
+            f"{base}/status", timeout=10).read())
+        serve_block = status.get("serve") or {}
+        if serve_block.get("skew_ref_step", -1) < 0:
+            raise SystemExit(
+                "FAIL: serve has no skew reference — the training "
+                f"smoke's manifest carried no sketches: {serve_block}"
+            )
+        if serve_block.get("skew_psi_max", 1.0) > 0.25:
+            raise SystemExit(
+                "FAIL: identity traffic reads as skewed "
+                f"(skew_psi_max {serve_block.get('skew_psi_max')})"
+            )
+        shifted = "".join(
+            "0 " + " ".join(
+                f"{45 + (i + j) % 5}:{(1 + j) * 100}" for j in range(4)
+            ) + "\n"
+            for i in range(300)
+        )
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/score", data=shifted.encode(), method="POST"
+        ), timeout=30).read()
+        time.sleep(0.6)  # skew block memo window
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=10).read().decode()
+        m = re.search(
+            r"^tffm_serve_skew_psi_max ([0-9.eE+-]+)", metrics,
+            re.MULTILINE,
+        )
+        if m is None or float(m.group(1)) <= 0.25:
+            raise SystemExit(
+                "FAIL: shifted traffic did not breach "
+                f"tffm_serve_skew_psi_max (got "
+                f"{m.group(1) if m else 'no series'})"
+            )
         print(f"serve smoke ok: scored 10/10 over the socket, "
               f"tffm_serve_* series present, {swaps} hot-swap(s) "
-              f"mid-traffic")
+              f"mid-traffic, skew breach visible "
+              f"(tffm_serve_skew_psi_max {float(m.group(1)):.2f} "
+              f"after shifted traffic)")
     finally:
         if proc.poll() is None:
             proc.terminate()
@@ -450,6 +502,16 @@ def check_router(cfg_path: str, data: str) -> None:
                 )
             time.sleep(0.3)
         check_prometheus(metrics)
+        # Fleet-wide skew visibility: the scrape max-merges each
+        # replica's skew_* keys under the same names, so the ROUTER's
+        # /metrics carries tffm_serve_skew_examples (and the psi
+        # series once enough traffic flows) — one scrape sees
+        # fleet-wide training→serving skew.
+        if "tffm_serve_skew_examples" not in metrics:
+            raise SystemExit(
+                "FAIL: router /metrics carries no fleet-merged "
+                "tffm_serve_skew_* series"
+            )
         # Kill one replica mid-traffic: every request must keep
         # succeeding (the router retries in-flight requests on the
         # survivor) and the eviction must show on /metrics.
@@ -680,9 +742,24 @@ max_features = 4
             raise SystemExit(
                 f"FAIL: training run exited {proc.returncode}"
             )
+        # Model-quality plane: the final record must carry the quality
+        # block (windowed eval + sketch counts) — default-on, like the
+        # resource block above.
+        finals = [
+            json.loads(line)
+            for line in open(os.path.join(tmpdir, "metrics.jsonl"))
+        ]
+        final = [r for r in finals if r.get("record") == "final"][-1]
+        q = final.get("quality") or {}
+        if not q.get("examples") or not q.get("sketch_examples"):
+            raise SystemExit(
+                f"FAIL: final record's quality block is missing or "
+                f"empty: {q}"
+            )
         print(
             f"obs smoke ok: /status step={status['step']}, /metrics "
-            f"served {n} Prometheus samples, run exited 0"
+            f"served {n} Prometheus samples, quality block eval'd "
+            f"{q['examples']} examples, run exited 0"
         )
     finally:
         if proc.poll() is None:

@@ -239,6 +239,28 @@ def _print_breakdown(rec: dict) -> None:
     else:
         print("\nserving: n/a (stream has no serve block — training "
               "run or pre-serve stream)")
+    quality = rec.get("quality")
+    if quality:
+        print("\nquality & drift (model-quality block):")
+        for key in ("examples", "window_examples", "logloss", "auc",
+                    "score_mean", "label_rate", "calib_ratio",
+                    "logloss_drift", "psi_values", "psi_lengths",
+                    "psi_ids", "psi_scores", "psi_max",
+                    "sketch_examples"):
+            if key in quality:
+                print(f"  {key:22s} {quality[key]}")
+        if quality.get("psi_max", 0.0) > 0.25:
+            print("  !! adjacent-window PSI above 0.25 — the input "
+                  "distribution SHIFTED mid-run (0.1-0.25 reads as "
+                  "drifting, > 0.25 as shifted)")
+        calib = quality.get("calib_ratio")
+        if calib is not None and not 0.8 <= calib <= 1.25:
+            print("  !! calibration ratio far from 1.0 — mean "
+                  "predicted rate disagrees with the observed label "
+                  "rate")
+    else:
+        print("\nquality & drift: n/a (stream has no quality block — "
+              "pre-quality run or quality=off)")
     tiered = rec.get("tiered") or {}
     if tiered:
         print("\ntiered embedding table (hot/cold migration):")
@@ -1028,6 +1050,26 @@ _DIRECTION_OVERRIDES = {
     "score_mean": "both", "score_std": "both",
     "score_p10": "both", "score_p50": "both", "score_p90": "both",
     "score_n": None,
+    # Model quality & drift (ISSUE 15): windowed logloss and every PSI
+    # axis regress when they RISE, windowed AUC when it FALLS; the
+    # calibration ratio is two-sided like the canary score stats (a
+    # systematic over- OR under-prediction is the regression) — so is
+    # logloss_drift in principle, but a RISING window loss is the
+    # page-worthy direction.  Counts are informational.  Bench keys:
+    # quality_overhead is a cost ratio like the other obs probes;
+    # quality_psi_identity is the self-skew floor (identity traffic
+    # must read ~0, so any rise is a sketch/PSI correctness drift).
+    "quality.logloss": "low", "quality.auc": "high",
+    "quality.calib_ratio": "both",
+    "quality.logloss_drift": "low",
+    "quality.psi_values": "low", "quality.psi_lengths": "low",
+    "quality.psi_ids": "low", "quality.psi_scores": "low",
+    "quality.psi_max": "low",
+    "quality.examples": None, "quality.window_examples": None,
+    "serve.skew_psi_values": "low", "serve.skew_psi_lengths": "low",
+    "serve.skew_psi_ids": "low", "serve.skew_psi_scores": "low",
+    "serve.skew_psi_max": "low", "serve.skew_examples": None,
+    "quality_overhead": "low", "quality_psi_identity": "low",
     # Static-analysis cleanliness (PR 10): bench preflight runs
     # `python -m tools.lint` and records the NEW-finding count — a PR
     # that introduces one regresses the bench compare like any perf
@@ -1106,6 +1148,21 @@ def _comparable_metrics(path: str) -> dict:
                 "recompiles_unexpected", "shed", "shed_frac",
                 "burn_rate", "slo_bad_frac", "respawns", "evictions",
                 "retries"):
+        val = (final.get("serve") or {}).get(key)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[f"serve.{key}"] = float(val)
+    # Quality block (ISSUE 15): the model-quality/drift axes.  Streams
+    # without the block (pre-quality runs, quality=off) contribute no
+    # quality.* keys — same shared-set back-compat as resource/serve.
+    for key in ("logloss", "auc", "calib_ratio", "logloss_drift",
+                "psi_values", "psi_lengths", "psi_ids", "psi_scores",
+                "psi_max", "examples", "window_examples"):
+        val = (final.get("quality") or {}).get(key)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[f"quality.{key}"] = float(val)
+    # Serving skew keys live inside the serve block (skew_*).
+    for key in ("skew_psi_values", "skew_psi_lengths", "skew_psi_ids",
+                "skew_psi_scores", "skew_psi_max", "skew_examples"):
         val = (final.get("serve") or {}).get(key)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             out[f"serve.{key}"] = float(val)
