@@ -1120,6 +1120,97 @@ def _bench_serve(workers: int) -> dict:
                 _sh2.rmtree(trace_dir, ignore_errors=True)
         except Exception as e:  # noqa: BLE001 - probe must not sink it
             out["trace_probe_error"] = f"{type(e).__name__}: {e}"
+        # Vectorized-parser speedup probe (ISSUE 16): the SAME decoded
+        # request bodies through parse_request twice — the vec path
+        # (the default this section serves with) vs the legacy
+        # per-line loop — direct calls, no HTTP, so the ratio isolates
+        # the parser.  Median-of-3 windows per mode so one GC pause
+        # can't set the headline.
+        try:
+            import dataclasses as _dc3
+
+            from fast_tffm_tpu.serve.textparse import parse_request
+
+            texts = [b.decode() for b in bodies]
+            leg_cfg = _dc3.replace(cfg, serve_parse_mode="legacy")
+
+            def _parse_window(pcfg) -> float:
+                p0 = time.perf_counter()
+                for txt in texts:
+                    parse_request(txt, pcfg)
+                return time.perf_counter() - p0
+
+            _parse_window(cfg)  # warm both paths once
+            _parse_window(leg_cfg)
+            vec_s = sorted(_parse_window(cfg) for _ in range(3))[1]
+            leg_s = sorted(_parse_window(leg_cfg) for _ in range(3))[1]
+            out["serve_parse_vec_speedup"] = (
+                round(leg_s / vec_s, 3) if vec_s > 0 else -1.0
+            )
+        except Exception as e:  # noqa: BLE001 - probe must not sink it
+            out["parse_probe_error"] = f"{type(e).__name__}: {e}"
+        # Pooled-accept toggle probe (ISSUE 16): paired client windows
+        # against the SAME warm batcher — the pooled front end above
+        # vs a legacy thread-per-connection mount
+        # (serve_http_threads=0) — back-to-back so box drift can't
+        # masquerade as an accept-model difference.
+        try:
+            import dataclasses as _dc4
+
+            l_cfg = _dc4.replace(cfg, serve_http_threads=0)
+            l_server = ServeServer(
+                0, batcher, l_cfg,
+                lambda: {"record": "status"}, telemetry=tel,
+            )
+            try:
+                l_url = f"http://127.0.0.1:{l_server.port}/score"
+                _rq.urlopen(_rq.Request(
+                    l_url, data=bodies[0], method="POST"
+                ), timeout=60).read()
+
+                def _accept_window(url_: str, dur: float):
+                    done = [0]
+
+                    def cl2(seed: int):
+                        r = np.random.default_rng(seed)
+                        end = time.perf_counter() + dur
+                        while time.perf_counter() < end:
+                            body = bodies[int(
+                                r.integers(0, len(bodies))
+                            )]
+                            try:
+                                _rq.urlopen(_rq.Request(
+                                    url_, data=body, method="POST"
+                                ), timeout=30).read()
+                            except Exception:  # noqa: BLE001 - end
+                                return
+                            with lat_lock:
+                                done[0] += 1
+
+                    ths2 = [
+                        _th.Thread(target=cl2, args=(900 + i,))
+                        for i in range(n_clients)
+                    ]
+                    a0 = time.perf_counter()
+                    for t in ths2:
+                        t.start()
+                    for t in ths2:
+                        t.join()
+                    return done[0], time.perf_counter() - a0
+
+                n_leg, w_leg = _accept_window(l_url, 2.0)
+                n_pool, w_pool = _accept_window(url, 2.0)
+                qps_leg = n_leg / w_leg if w_leg > 0 else 0.0
+                qps_pool = n_pool / w_pool if w_pool > 0 else 0.0
+                out["serve_qps_legacy_accept"] = round(qps_leg, 1)
+                out["serve_accept_pooled_x"] = (
+                    round(qps_pool / qps_leg, 4)
+                    if qps_leg > 0 else -1.0
+                )
+            finally:
+                l_server.close()
+        except Exception as e:  # noqa: BLE001 - probe must not sink it
+            out["accept_probe_error"] = f"{type(e).__name__}: {e}"
         out.update({
             "completed": True,
             "clients": n_clients,
@@ -1148,6 +1239,13 @@ def _bench_serve(workers: int) -> dict:
             ),
             "serve_bin_p50_ms": float(
                 (timers.get("serve.parse_bin") or {}).get("p50_ms", 0.0)
+            ),
+            # Which accept model served THIS section's numbers: the
+            # pooled worker front end (serve_http_threads > 0) or the
+            # legacy thread-per-connection server.
+            "serve_http_threads": int(cfg.serve_http_threads),
+            "serve_accept_pooled": (
+                1 if cfg.serve_http_threads > 0 else 0
             ),
         })
         if errors:
@@ -2057,7 +2155,10 @@ def main() -> int:
                 )
     if serve_section is not None and serve_section.get("completed"):
         for key in ("serve_table_mb", "serve_parse_p50_ms",
-                    "serve_bin_p50_ms", "serve_quant_error_max_int8"):
+                    "serve_bin_p50_ms", "serve_quant_error_max_int8",
+                    "serve_parse_vec_speedup", "serve_accept_pooled",
+                    "serve_accept_pooled_x", "serve_qps_legacy_accept",
+                    "serve_http_threads"):
             if key in serve_section:
                 result[key] = serve_section[key]
     if tier1_audit is not None:

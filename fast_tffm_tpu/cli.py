@@ -277,6 +277,24 @@ def build_argparser() -> argparse.ArgumentParser:
              "gauge divides by (0 = no burn-rate accounting)",
     )
     p.add_argument(
+        "--serve_parse_mode", choices=["vec", "legacy"], default=None,
+        help="POST /score text-parse engine: the vectorized batch "
+             "parser (default) or the per-line legacy loop (both "
+             "bitwise-identical; the knob exists for bisection)",
+    )
+    p.add_argument(
+        "--serve_http_threads", type=int, default=None,
+        help="HTTP front-end worker pool size for the scoring "
+             "endpoints (0 = thread-per-connection legacy mode); "
+             "size >= expected concurrent kept-alive connections",
+    )
+    p.add_argument(
+        "--serve_http_acceptors", type=int, default=None,
+        help="accept loops for the pooled front end (>1 uses "
+             "SO_REUSEPORT listeners when the kernel supports it, "
+             "shared-socket fallback otherwise)",
+    )
+    p.add_argument(
         "--metrics_file", default=None, metavar="PATH",
         help="JSONL metrics stream path (overrides the cfg; a "
              "multi-replica fleet suffixes each replica's stream "
@@ -338,6 +356,8 @@ def main(argv=None) -> int:
                     "serve_shed_deadline_ms", "serve_canary",
                     "serve_transport", "serve_trace_sample",
                     "serve_slo_p99_ms", "serve_slo_availability",
+                    "serve_parse_mode", "serve_http_threads",
+                    "serve_http_acceptors",
                     "quality_window", "metrics_file")
         if getattr(args, key) is not None
     }

@@ -195,6 +195,27 @@ class FmConfig:
     # accounting (slo_bad_frac still reports when serve_slo_p99_ms is
     # set).  See OBSERVABILITY.md "Serving SLO & burn rate".
     serve_slo_availability: float = 0.0
+    # Text-parse engine for POST /score: "vec" (default) runs the
+    # batch parser (serve/textparse.py — one regex validation pass +
+    # strided/vectorized conversion over the whole body, with
+    # automatic per-line fallback on out-of-grammar input), "legacy"
+    # forces the per-line libsvm.parse_line loop.  Both are pinned
+    # bitwise-identical (arrays AND error text) by test; the knob
+    # exists for bisection and as the fallback's direct spelling.
+    serve_parse_mode: str = "vec"
+    # HTTP front-end worker pool for the scoring endpoints (server AND
+    # router): this many persistent handler threads serve accepted
+    # connections from a bounded hand-off queue instead of spawning a
+    # thread per connection.  Size it >= the expected concurrent
+    # kept-alive connections (a kept-alive peer holds a worker until
+    # it closes or the 60 s socket timeout fires).  0 = the r14
+    # thread-per-connection mode, byte-identical serving behavior.
+    serve_http_threads: int = 8
+    # Accept-loop count for the pooled front end: N > 1 adds N-1 extra
+    # accept loops, each on its own SO_REUSEPORT listener when the
+    # kernel supports it (feature-probed; portable fallback shares the
+    # primary socket).  Only meaningful with serve_http_threads > 0.
+    serve_http_acceptors: int = 1
 
     # --- observability (SURVEY.md §5: tracing/metrics rebuild) ---
     # Directory for a jax.profiler trace of steps
@@ -600,6 +621,29 @@ class FmConfig:
                 "router's promotion watcher polls the manifest at "
                 "that cadence)"
             )
+        if self.serve_parse_mode not in ("vec", "legacy"):
+            raise ValueError(
+                f"unknown serve_parse_mode {self.serve_parse_mode!r} "
+                "(expected 'vec' or 'legacy')"
+            )
+        if self.serve_http_threads < 0:
+            raise ValueError(
+                "serve_http_threads must be >= 0 (0 = thread-per-"
+                f"connection), got {self.serve_http_threads}"
+            )
+        if self.serve_http_acceptors < 1:
+            raise ValueError(
+                "serve_http_acceptors must be >= 1, got "
+                f"{self.serve_http_acceptors}"
+            )
+        if self.serve_http_acceptors > 1 and self.serve_http_threads == 0:
+            # The silently-inert-knob discipline: extra accept loops
+            # only exist in the pooled front end; with the pool off the
+            # knob could never do anything.
+            raise ValueError(
+                "serve_http_acceptors > 1 requires serve_http_threads "
+                "> 0 (extra accept loops feed the pooled front end)"
+            )
         self.serve_ladder  # parse/validate serve_batch_sizes at startup
         if self.cache_max_bytes <= 0:
             raise ValueError(
@@ -740,6 +784,9 @@ _KEYMAP = {
     "serve_trace_sample": ("serve_trace_sample", float),
     "serve_slo_p99_ms": ("serve_slo_p99_ms", float),
     "serve_slo_availability": ("serve_slo_availability", float),
+    "serve_parse_mode": ("serve_parse_mode", str),
+    "serve_http_threads": ("serve_http_threads", int),
+    "serve_http_acceptors": ("serve_http_acceptors", int),
     "profile_dir": ("profile_dir", str),
     "profile_start_step": ("profile_start_step", int),
     "profile_steps": ("profile_steps", int),

@@ -44,7 +44,10 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import re
+import select
+import socket
 import sys
 import threading
 import traceback
@@ -53,8 +56,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 __all__ = [
-    "ObsHTTPServer", "QuietHandler", "StatusServer",
-    "render_prometheus", "thread_dump",
+    "ObsHTTPServer", "PooledHTTPServer", "QuietHandler", "StatusServer",
+    "probe_reuseport", "render_prometheus", "thread_dump",
 ]
 
 log = logging.getLogger(__name__)
@@ -207,6 +210,239 @@ class ObsHTTPServer(ThreadingHTTPServer):
 
     daemon_threads = True
     request_queue_size = 128
+
+
+def probe_reuseport() -> bool:
+    """True when this platform both DEFINES ``SO_REUSEPORT`` and
+    accepts it on a stream socket (the constant exists on some kernels
+    that still reject the setsockopt) — the feature probe behind
+    ``PooledHTTPServer``'s multi-listener mode.  Pure capability check:
+    binds nothing."""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        return False
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        return True
+    except OSError:
+        return False
+
+
+class PooledHTTPServer(ObsHTTPServer):
+    """:class:`ObsHTTPServer` with a FIXED pool of persistent handler
+    workers instead of a thread spawn per connection.
+
+    Thread-per-connection pays a spawn + teardown on every accepted
+    socket and funnels every accept through the one ``serve_forever``
+    loop; under the router's burst traffic both show up directly in
+    ``serve_burst_p99_x``.  Here accepted connections land in a bounded
+    hand-off queue and ``pool_size`` long-lived workers serve them —
+    the router's backend connection pool lands on warm handlers, and a
+    connection spike backpressures into the TCP backlog (blocking
+    ``put``) instead of spawning unbounded threads.
+
+    ``acceptors > 1`` adds N-1 extra accept loops.  When the kernel
+    supports ``SO_REUSEPORT`` (:func:`probe_reuseport`), each extra
+    loop gets its OWN listener socket bound to the same address — the
+    kernel load-balances connections across listeners and the accept
+    path stops serializing on one socket lock.  Portable fallback:
+    the extra loops ``accept()`` on the shared primary socket.  The
+    effective mode is published as ``self.reuseport``.
+
+    Keep-alive interacts with pooling the obvious way: a kept-alive
+    connection HOLDS its worker until the peer closes or the 60 s
+    handler socket timeout fires (exactly like a handler thread did,
+    but now from a finite pool) — so ``pool_size`` must cover the
+    expected concurrent kept-alive connections; SERVING.md has the
+    sizing rule.  The request-level discipline (60 s timeout,
+    keep-alive, TCP_NODELAY, Content-Length) is the handler class's
+    and is untouched.
+
+    ``server_close()`` tears the whole shape down deterministically:
+    stops the accept loops, drops queued-but-unserved connections
+    (a queued slow peer must not pin close for its socket timeout),
+    aborts in-flight reads with ``SHUT_RDWR``, then joins every worker
+    and acceptor — zero leaked threads, pinned by test and the TL007
+    lint rule.
+    """
+
+    def __init__(self, server_address, RequestHandlerClass,
+                 pool_size: int = 8, acceptors: int = 1,
+                 bind_and_activate: bool = True):
+        self.pool_size = max(1, int(pool_size))
+        self.acceptors = max(1, int(acceptors))
+        self.reuseport = False
+        self._stop_accept = threading.Event()
+        self._pool_closed = False
+        self._active: set = set()
+        self._active_lock = threading.Lock()
+        self._conn_q: queue.Queue = queue.Queue(
+            maxsize=max(32, 2 * self.pool_size)
+        )
+        self._extra_socks: list = []
+        self._acceptors: list = []
+        self._workers = [
+            threading.Thread(
+                target=self._worker, name=f"tffm-http-worker-{i}",
+                daemon=True,
+            )
+            for i in range(self.pool_size)
+        ]
+        # server_bind (called by super().__init__) reads self.acceptors
+        # to decide on SO_REUSEPORT, so state init precedes it.
+        super().__init__(server_address, RequestHandlerClass,
+                         bind_and_activate=bind_and_activate)
+        for t in self._workers:
+            t.start()
+        if bind_and_activate:
+            self._start_extra_acceptors()
+
+    # -- accept side ---------------------------------------------------
+
+    def server_bind(self) -> None:
+        if self.acceptors > 1 and probe_reuseport():
+            try:
+                self.socket.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+                )
+                self.reuseport = True
+            except OSError:
+                self.reuseport = False
+        super().server_bind()
+
+    def _start_extra_acceptors(self) -> None:
+        for i in range(self.acceptors - 1):
+            sock = self.socket
+            if self.reuseport:
+                try:
+                    s = socket.socket(
+                        self.address_family, self.socket_type
+                    )
+                    s.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+                    )
+                    # server_address is the RESOLVED one (port-0 safe).
+                    s.bind(self.server_address)
+                    s.listen(self.request_queue_size)
+                    self._extra_socks.append(s)
+                    sock = s
+                except OSError:
+                    sock = self.socket  # shared-socket fallback
+            t = threading.Thread(
+                target=self._accept_loop, args=(sock,),
+                name=f"tffm-http-accept-{i + 1}", daemon=True,
+            )
+            self._acceptors.append(t)
+            t.start()
+
+    def _accept_loop(self, sock) -> None:
+        """One extra acceptor: select (so shutdown is prompt) ->
+        accept -> the same verify/process contract as BaseServer's
+        ``_handle_request_noblock``."""
+        while not self._stop_accept.is_set():
+            try:
+                ready, _, _ = select.select([sock], [], [], 0.5)
+            except OSError:
+                break  # socket closed under us: shutting down
+            if not ready:
+                continue
+            try:
+                request, client_address = sock.accept()
+            except OSError:
+                continue
+            if self.verify_request(request, client_address):
+                try:
+                    self.process_request(request, client_address)
+                except Exception:  # noqa: BLE001 - keep accepting
+                    self.handle_error(request, client_address)
+                    self.shutdown_request(request)
+            else:
+                self.shutdown_request(request)
+
+    def process_request(self, request, client_address) -> None:
+        """Hand the accepted connection to the pool.  The put BLOCKS
+        when every worker is busy and the queue is full — backpressure
+        lands in the TCP backlog, which is the overload surface the
+        router's shed discipline already reasons about."""
+        self._conn_q.put((request, client_address))
+
+    # -- worker side ---------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._conn_q.get()
+            if item is None:
+                return
+            request, client_address = item
+            with self._active_lock:
+                if self._pool_closed:
+                    # Raced server_close's drain: drop, don't serve.
+                    dropped = True
+                else:
+                    self._active.add(request)
+                    dropped = False
+            if dropped:
+                self._shutdown_quiet(request)
+                continue
+            try:
+                self.finish_request(request, client_address)
+            except Exception:  # noqa: BLE001 - mirror ThreadingMixIn
+                self.handle_error(request, client_address)
+            finally:
+                with self._active_lock:
+                    self._active.discard(request)
+                self._shutdown_quiet(request)
+
+    def _shutdown_quiet(self, request) -> None:
+        try:
+            self.shutdown_request(request)
+        except OSError:
+            pass
+
+    # -- teardown ------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._stop_accept.set()
+        super().shutdown()
+
+    def server_close(self) -> None:
+        # Belt and braces: owners call shutdown() first, but a server
+        # whose serve_forever never ran is closed without it (and
+        # BaseServer.shutdown would block forever there).
+        self._stop_accept.set()
+        super().server_close()
+        for s in self._extra_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        # Acceptors exit promptly: sockets are closed and the stop
+        # event is set; a put-blocked acceptor unblocks because the
+        # workers below keep draining until their sentinel.
+        with self._active_lock:
+            self._pool_closed = True
+            active = list(self._active)
+        while True:
+            try:
+                item = self._conn_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._shutdown_quiet(item[0])
+        for request in active:
+            # Abort in-flight reads so a worker parked in a blocking
+            # recv (kept-alive idle, slow peer) wakes NOW instead of
+            # at its socket timeout.  The worker still owns the close.
+            try:
+                request.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for _ in self._workers:
+            self._conn_q.put(None)
+        for t in self._workers:
+            t.join()
+        for t in self._acceptors:
+            t.join()
 
 
 class QuietHandler(BaseHTTPRequestHandler):

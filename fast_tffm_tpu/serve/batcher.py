@@ -63,12 +63,21 @@ class ScoreRequest:
     ``rid`` is the distributed-trace request id (None = unsampled);
     ``t_picked`` is stamped by the dispatcher when the request leaves
     the queue, only for rid-carrying requests (span reconstruction
-    needs it; the unsampled path skips the write)."""
+    needs it; the unsampled path skips the write).
+
+    ``on_done`` is the scratch-release hook for pooled parse buffers
+    (serve/textparse.py): the batcher fires it exactly once when it is
+    DONE READING ``ids``/``vals``/``fields`` — after the microbatch
+    copy and the quality fold on the success path, after stamping the
+    error on every failure path.  The client's ``result()`` wait is
+    NOT the release point: a client timeout abandons a request the
+    dispatcher still holds, and releasing then would let the pool hand
+    the buffer to a new request while the dispatcher reads it."""
 
     __slots__ = ("ids", "vals", "fields", "n", "event", "scores",
-                 "error", "t0", "rid", "t_picked")
+                 "error", "t0", "rid", "t_picked", "on_done")
 
-    def __init__(self, ids, vals, fields, rid=None):
+    def __init__(self, ids, vals, fields, rid=None, on_done=None):
         self.ids = ids
         self.vals = vals
         self.fields = fields
@@ -79,6 +88,17 @@ class ScoreRequest:
         self.t0 = time.perf_counter()
         self.rid = rid
         self.t_picked: Optional[float] = None
+        self.on_done = on_done
+
+    def finish(self) -> None:
+        """Fire ``on_done`` exactly once (swap-to-None makes repeated
+        calls from overlapping failure paths safe)."""
+        cb, self.on_done = self.on_done, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001 - release must not
+                log.warning("on_done release hook failed: %s", e)
 
 
 class ServeBatcher:
@@ -133,18 +153,27 @@ class ServeBatcher:
 
     # -- client side ---------------------------------------------------
 
-    def submit(self, ids, vals, fields=None, rid=None) -> ScoreRequest:
+    def submit(self, ids, vals, fields=None, rid=None,
+               on_done=None) -> ScoreRequest:
         """Enqueue ``[n, max_features]`` arrays; returns the request
-        future.  Raises RuntimeError once the batcher is closed."""
+        future.  Raises RuntimeError once the batcher is closed.
+        ``on_done`` (optional) fires exactly once when the batcher no
+        longer reads the arrays — including on every rejection path of
+        this call, so a pooled caller never leaks a lease.  NOTE the
+        ``ascontiguousarray`` casts are no-copy for the parse pool's
+        row views (C-contiguous slices of the right dtype), so the
+        arrays the dispatcher reads ARE the pooled buffers."""
         req = ScoreRequest(
             np.ascontiguousarray(ids, np.int32),
             np.ascontiguousarray(vals, np.float32),
             (np.ascontiguousarray(fields, np.int32)
              if fields is not None else None),
             rid=rid,
+            on_done=on_done,
         )
         with self._out_lock:
             if self._closed:
+                req.finish()
                 raise RuntimeError("ServeBatcher is closed")
             self._outstanding.add(req)
             self._g_inflight.set(len(self._outstanding))
@@ -152,6 +181,7 @@ class ServeBatcher:
             with self._out_lock:
                 self._outstanding.discard(req)
                 self._g_inflight.set(len(self._outstanding))
+            req.finish()
             raise RuntimeError("ServeBatcher is closed")
         self._c_requests.add()
         return req
@@ -175,10 +205,11 @@ class ServeBatcher:
         return req.scores
 
     def score(self, ids, vals, fields=None, timeout: float = 30.0,
-              rid=None) -> np.ndarray:
+              rid=None, on_done=None) -> np.ndarray:
         """submit + result in one call (the HTTP handler's path)."""
         return self.result(
-            self.submit(ids, vals, fields, rid=rid), timeout
+            self.submit(ids, vals, fields, rid=rid, on_done=on_done),
+            timeout,
         )
 
     @property
@@ -342,6 +373,10 @@ class ServeBatcher:
                         )
                 except Exception as e:  # noqa: BLE001 - observe only
                     log.warning("skew sketching failed: %s", e)
+            # Last reader done (microbatch copy + quality fold both
+            # read g.ids/g.vals): release pooled parse scratch.
+            for g in group:
+                g.finish()
         except BaseException as e:  # noqa: BLE001 - fail the CLIENTS
             log.warning("serve dispatch failed: %s", e)
             for g in group:
@@ -352,6 +387,7 @@ class ServeBatcher:
                     self._outstanding.discard(g)
                     self._g_inflight.set(len(self._outstanding))
                 g.event.set()
+                g.finish()
 
     def _fail_outstanding(self, exc: BaseException) -> None:
         with self._out_lock:
@@ -361,6 +397,7 @@ class ServeBatcher:
         for req in stale:
             req.error = exc
             req.event.set()
+            req.finish()
 
     def close(self) -> None:
         """Stop the dispatcher and fail any queued requests.

@@ -63,7 +63,7 @@ import numpy as np
 from fast_tffm_tpu import obs
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.obs.status import (
-    ObsHTTPServer, QuietHandler, render_prometheus,
+    ObsHTTPServer, PooledHTTPServer, QuietHandler, render_prometheus,
 )
 from fast_tffm_tpu.obs.trace import NULL_TRACER, Tracer
 from fast_tffm_tpu.serve import wire
@@ -526,7 +526,18 @@ class ServeRouter:
             target=self._health_loop, name="tffm-router-health",
             daemon=True,
         )
-        self._httpd = ObsHTTPServer((host, port), Handler)
+        # The router front door shares the serve endpoints' pooled
+        # accept path (serve_http_threads > 0, the default); 0 keeps
+        # thread-per-connection.  Two plain assignments so the
+        # lifecycle lint sees both constructor bindings.
+        if cfg.serve_http_threads > 0:
+            self._httpd = PooledHTTPServer(
+                (host, port), Handler,
+                pool_size=cfg.serve_http_threads,
+                acceptors=cfg.serve_http_acceptors,
+            )
+        else:
+            self._httpd = ObsHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="tffm-router-http",
@@ -1520,6 +1531,14 @@ def start_fleet(cfg: FmConfig, cfg_path: str,
                 "serve_trace_sample": cfg.serve_trace_sample,
                 "serve_slo_p99_ms": cfg.serve_slo_p99_ms,
                 "serve_slo_availability": cfg.serve_slo_availability,
+                # Front-end shape knobs (shared with the replicas via
+                # the relayed config): the fleet's accept path must be
+                # reconstructable from any metrics stream.
+                "serve_parse_mode": cfg.serve_parse_mode,
+                "serve_http_threads": cfg.serve_http_threads,
+                "serve_http_acceptors": cfg.serve_http_acceptors,
+                "serve_request_queue_size":
+                    ObsHTTPServer.request_queue_size,
                 "alert_rules": cfg.alert_rules,
                 "trace_file": cfg.trace_file,
                 "replica_ports": [r.port for r in manager.replicas],

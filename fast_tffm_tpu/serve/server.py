@@ -53,13 +53,15 @@ import numpy as np
 
 from fast_tffm_tpu import obs
 from fast_tffm_tpu.config import FmConfig
-from fast_tffm_tpu.data import libsvm
-from fast_tffm_tpu.obs.status import ObsHTTPServer, QuietHandler
+from fast_tffm_tpu.obs.status import (
+    ObsHTTPServer, PooledHTTPServer, QuietHandler,
+)
 from fast_tffm_tpu.obs.trace import NULL_TRACER, Tracer
 from fast_tffm_tpu.serve import wire
 from fast_tffm_tpu.serve.batcher import ServeBatcher
 from fast_tffm_tpu.serve import scorer as scorer_lib
 from fast_tffm_tpu.serve.slo import SloTracker
+from fast_tffm_tpu.serve.textparse import ParseScratchPool, parse_request
 from fast_tffm_tpu.train import checkpoint
 
 log = logging.getLogger(__name__)
@@ -76,61 +78,16 @@ encode_bin_request = wire.encode_bin_request
 encode_bin_response = wire.encode_bin_response
 
 __all__ = [
-    "BIN_MAGIC", "CheckpointWatcher", "ServeHandle", "ServeServer",
-    "decode_bin_request", "decode_bin_response", "encode_bin_request",
-    "encode_bin_response", "parse_request", "reload_scorer", "serve",
-    "serve_forever",
+    "BIN_MAGIC", "CheckpointWatcher", "ParseScratchPool", "ServeHandle",
+    "ServeServer", "decode_bin_request", "decode_bin_response",
+    "encode_bin_request", "encode_bin_response", "parse_request",
+    "reload_scorer", "serve", "serve_forever",
 ]
 
-
-def parse_request(text: str, cfg: FmConfig):
-    """Request body -> ``(ids, vals, fields, n, truncated)`` arrays.
-
-    One example per non-blank, non-comment line, ``predict_files``
-    format.  A line whose FIRST token contains ``:`` is treated as
-    label-less (scoring clients rarely have labels); anything else goes
-    through :func:`libsvm.parse_line` unchanged, so request files and
-    predict files are interchangeable.  NOTE the inherent libsvm
-    ambiguity this rule resolves deterministically: a line of BARE
-    feature ids ("123 456 789") is indistinguishable from a labeled
-    line, so its first token is always read as the label — bare-id
-    clients must send an explicit label column (or ``id:1`` tokens);
-    documented in SERVING.md.  Raises ValueError (-> HTTP 400) on a
-    malformed line.  ``truncated`` counts feature occurrences
-    dropped by ``max_features`` — a truncated example scores as a
-    DIFFERENT example, the same data-integrity event the ingest path
-    surfaces as ``ingest.truncated_features`` (the server counts it as
-    ``serve.truncated_features``).
-    """
-    examples = []
-    for lineno, line in enumerate(text.splitlines(), 1):
-        stripped = line.strip()
-        if not stripped or stripped.startswith("#"):
-            continue
-        if ":" in stripped.split(None, 1)[0]:
-            stripped = "0 " + stripped
-        try:
-            ex = libsvm.parse_line(
-                stripped, cfg.vocabulary_size, cfg.hash_feature_id,
-                cfg.field_num,
-            )
-        except ValueError as e:
-            raise ValueError(f"line {lineno}: {e}") from e
-        if ex is not None:
-            examples.append(ex)
-    n = len(examples)
-    F = cfg.max_features
-    ids = np.zeros((n, F), np.int32)
-    vals = np.zeros((n, F), np.float32)
-    fields = np.zeros((n, F), np.int32)
-    truncated = 0
-    for i, ex in enumerate(examples):
-        k = min(len(ex.ids), F)
-        truncated += len(ex.ids) - k
-        ids[i, :k] = ex.ids[:k]
-        vals[i, :k] = ex.vals[:k]
-        fields[i, :k] = ex.fields[:k]
-    return ids, vals, fields, n, truncated
+# parse_request lives in serve/textparse.py now (the vectorized batch
+# parser + its per-line fallback/oracle and the scratch pool); it is
+# re-exported above because the serving tests and embedders have always
+# imported it from here.
 
 
 def reload_scorer(cfg: FmConfig, scorer, keep_prev: bool = False) -> int:
@@ -258,6 +215,12 @@ class ServeServer:
         # serve_bin_p50_ms).
         parse_t = tel.timer("serve.parse")
         parse_bin_t = tel.timer("serve.parse_bin")
+        # Recycled per-request parse scratch (textparse.py): the text
+        # path's arrays come from here and go back via the batcher's
+        # on_done hook — steady-state text scoring allocates near-zero
+        # per request.  The binary transport decodes straight out of
+        # the request body (np.frombuffer views) and stays unpooled.
+        parse_pool = ParseScratchPool(cfg.max_features, telemetry=tel)
         # The admin swap surface is driven over HTTP by the router's
         # canary protocol; one at a time (a reload stages a whole
         # standby table — two concurrent ones would race the rollback
@@ -266,18 +229,25 @@ class ServeServer:
         server = self
 
         def score_arrays(handler, ids, vals, fields, n, truncated,
-                         encode, rid=None) -> None:
+                         encode, rid=None, on_done=None) -> None:
             """Shared tail of both transports: count integrity events,
             batch-score, encode the response.  ``rid`` (a sampled or
             client-supplied request id) is echoed in the response's
             ``X-Request-Id`` header and closes the request's span
-            chain with a ``serve.respond`` span."""
+            chain with a ``serve.respond`` span.  ``on_done`` is the
+            pooled-scratch release hook: from here on the BATCHER owns
+            firing it (exactly once, when its dispatcher stops reading
+            the arrays — a client-side timeout must NOT release a
+            buffer the dispatcher still holds); the n == 0 early-out
+            never submits, so it releases directly."""
             if truncated:
                 # Same integrity signal the ingest path counts: a
                 # truncated example scores as a different example.
                 truncated_c.add(truncated)
             rid_hdr = {"X-Request-Id": rid} if rid is not None else None
             if n == 0:
+                if on_done is not None:
+                    on_done()
                 ctype, body = encode(np.zeros((0,), np.float32))
                 handler._send(200, body, ctype, headers=rid_hdr)
                 return
@@ -285,7 +255,7 @@ class ServeServer:
                 scores = batcher.score(
                     ids, vals,
                     fields if cfg.field_num else None,
-                    timeout=timeout_s, rid=rid,
+                    timeout=timeout_s, rid=rid, on_done=on_done,
                 )
             except Exception as e:  # noqa: BLE001 - report, don't die
                 if slo is not None:
@@ -352,11 +322,15 @@ class ServeServer:
                 rid = self.headers.get("X-Request-Id")
                 if rid is not None and not wire.valid_request_id(rid):
                     rid = None
+                on_done = None
                 try:
                     if path == "/score":
                         with parse_t.time():
-                            parsed = parse_request(body.decode(), cfg)
+                            parsed = parse_request(
+                                body.decode(), cfg, pool=parse_pool
+                            )
                         ids, vals, fields, n, truncated = parsed
+                        on_done = lambda i=ids: parse_pool.release(i)  # noqa: E731
                     else:
                         with parse_bin_t.time():
                             (ids, vals, fields, n, truncated,
@@ -383,7 +357,7 @@ class ServeServer:
                 score_arrays(
                     self, ids, vals, fields, n, truncated,
                     encode_text if path == "/score" else encode_bin,
-                    rid=rid,
+                    rid=rid, on_done=on_done,
                 )
 
             def _do_admin(self, path: str, query: str) -> None:
@@ -463,7 +437,19 @@ class ServeServer:
                 self._send(404, b"not found\n", "text/plain")
 
         self._build = build
-        self._httpd = ObsHTTPServer((host, port), Handler)
+        self.parse_pool = parse_pool
+        # Pooled front end by default; serve_http_threads = 0 keeps
+        # the r14 thread-per-connection server, byte-identical.  Two
+        # plain assignments (not one conditional expression) so the
+        # lifecycle lint sees both constructor bindings.
+        if cfg.serve_http_threads > 0:
+            self._httpd = PooledHTTPServer(
+                (host, port), Handler,
+                pool_size=cfg.serve_http_threads,
+                acceptors=cfg.serve_http_acceptors,
+            )
+        else:
+            self._httpd = ObsHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="tffm-serve-http",
@@ -568,6 +554,12 @@ def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
         "truncated_features": int(
             counters.get("serve.truncated_features", 0)
         ),
+        # Front-end shape: 0 = thread-per-connection.  In the record
+        # block (not only the run header) so any single metrics
+        # snapshot says which accept path produced its latencies.
+        "http_threads": int(getattr(
+            getattr(scorer, "cfg", None), "serve_http_threads", 0
+        ) or 0),
     }
     # Quantized-table accounting, emitted only when the scorer owns
     # the gauges (FixedShapeScorer): the device-resident table's real
@@ -721,6 +713,12 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             "max_batch_wait_ms": cfg.max_batch_wait_ms,
             "serve_poll_secs": cfg.serve_poll_secs,
             "serve_transport": cfg.serve_transport,
+            # Front-end shape knobs: a fleet's accept path must be
+            # reconstructable from any metrics stream (KD discipline).
+            "serve_parse_mode": cfg.serve_parse_mode,
+            "serve_http_threads": cfg.serve_http_threads,
+            "serve_http_acceptors": cfg.serve_http_acceptors,
+            "serve_request_queue_size": ObsHTTPServer.request_queue_size,
             "batch_size": cfg.batch_size,
             "telemetry": cfg.telemetry,
             "heartbeat_secs": cfg.heartbeat_secs,

@@ -142,6 +142,44 @@ class TestLifecycle:
             """)
         assert _by_rule(_findings(LifecycleRule(), ctx), "TL001")
 
+    def test_attr_worker_pool_unjoined_flagged(self, tmp_path):
+        """TL007 (ISSUE 16): the worker-pool shape — a list of threads
+        bound to a self attribute, whose teardown loop would live in
+        ANOTHER method.  No loop over the attribute = pooled handler
+        threads that outlive their server."""
+        ctx = _mini_repo(tmp_path, """\
+            import threading
+
+            class Pool:
+                def __init__(self, n):
+                    self._workers = [
+                        threading.Thread(target=print)
+                        for _ in range(n)
+                    ]
+                    for t in self._workers:
+                        t.start()
+            """)
+        found = _by_rule(_findings(LifecycleRule(), ctx), "TL007")
+        assert len(found) == 1
+        assert "self._workers" in found[0].message
+
+    def test_attr_worker_pool_joined_passes(self, tmp_path):
+        ctx = _mini_repo(tmp_path, """\
+            import threading
+
+            class Pool:
+                def __init__(self, n):
+                    self._workers = [
+                        threading.Thread(target=print)
+                        for _ in range(n)
+                    ]
+
+                def close(self):
+                    for t in self._workers:
+                        t.join()
+            """)
+        assert not _findings(LifecycleRule(), ctx)
+
     def test_queue_shm_server_teardowns(self, tmp_path):
         ctx = _mini_repo(tmp_path, """\
             from http.server import ThreadingHTTPServer

@@ -1,0 +1,391 @@
+"""Pooled HTTP front end (ISSUE 16): a fixed pool of persistent
+handler workers (plus optional SO_REUSEPORT acceptors) replaces
+thread-per-connection — same request-level discipline (keep-alive,
+timeouts, Content-Length), deterministic teardown with zero leaked
+threads, and the legacy server still mountable via
+``serve_http_threads = 0``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.obs.status import (
+    ObsHTTPServer, PooledHTTPServer, probe_reuseport,
+)
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    """Answers GET with the serving thread's name — the probe for
+    which pool worker handled the request."""
+
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):  # noqa: N802 - http.server API name
+        body = threading.current_thread().name.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+class _SlowHandler(_EchoHandler):
+    timeout = 1.0  # slow-loris eviction horizon for the test
+
+
+def _start(server):
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+def _no_pool_threads():
+    return [
+        t.name for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith("tffm-http-")
+    ]
+
+
+class TestPooledHTTPServer:
+    def test_keepalive_reuses_one_worker(self):
+        srv = PooledHTTPServer(("127.0.0.1", 0), _EchoHandler,
+                               pool_size=4)
+        st = _start(srv)
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.server_address[1], timeout=10
+            )
+            names = []
+            for _ in range(3):
+                conn.request("GET", "/")
+                resp = conn.getresponse()
+                names.append(resp.read().decode())
+                assert resp.status == 200
+            conn.close()
+            # A kept-alive connection pins its worker: all three
+            # requests ran on the SAME pool thread, and it is a pool
+            # thread, not a per-connection spawn.
+            assert len(set(names)) == 1
+            assert names[0].startswith("tffm-http-worker-")
+        finally:
+            srv.shutdown()
+            st.join(timeout=10)
+            srv.server_close()
+
+    def test_slow_loris_releases_worker(self):
+        """A peer that connects and sends nothing must only hold its
+        worker until the handler socket timeout — with pool_size=1
+        the NEXT request proves the worker came back."""
+        srv = PooledHTTPServer(("127.0.0.1", 0), _SlowHandler,
+                               pool_size=1)
+        st = _start(srv)
+        try:
+            loris = socket.create_connection(
+                ("127.0.0.1", srv.server_address[1]), timeout=10
+            )
+            loris.sendall(b"GET /")  # partial request line, then stall
+            time.sleep(0.2)  # let the lone worker pick the loris up
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.server_address[1]}/",
+                timeout=10,
+            ).read()
+            assert body.decode().startswith("tffm-http-worker-")
+            loris.close()
+        finally:
+            srv.shutdown()
+            st.join(timeout=10)
+            srv.server_close()
+
+    def test_concurrent_connections_spread_over_pool(self):
+        srv = PooledHTTPServer(("127.0.0.1", 0), _EchoHandler,
+                               pool_size=4)
+        st = _start(srv)
+        try:
+            names: list = []
+            lock = threading.Lock()
+
+            def hit():
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.server_address[1], timeout=10
+                )
+                conn.request("GET", "/")
+                name = conn.getresponse().read().decode()
+                time.sleep(0.3)  # keep-alive holds the worker
+                conn.close()
+                with lock:
+                    names.append(name)
+
+            ts = [threading.Thread(target=hit) for _ in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert len(names) == 4
+            assert len(set(names)) > 1  # not serialized on one worker
+        finally:
+            srv.shutdown()
+            st.join(timeout=10)
+            srv.server_close()
+
+    def test_teardown_leaks_no_threads(self):
+        srv = PooledHTTPServer(("127.0.0.1", 0), _EchoHandler,
+                               pool_size=3, acceptors=2)
+        st = _start(srv)
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.server_address[1]}/", timeout=10
+        ).read()
+        srv.shutdown()
+        st.join(timeout=10)
+        srv.server_close()
+        assert _no_pool_threads() == []
+
+    def test_close_without_serve_forever(self):
+        """server_close on a never-served pool must not hang (the
+        accept loops may never have started serve_forever)."""
+        srv = PooledHTTPServer(("127.0.0.1", 0), _EchoHandler,
+                               pool_size=2)
+        srv.server_close()
+        assert _no_pool_threads() == []
+
+    def test_acceptors_smoke(self):
+        srv = PooledHTTPServer(("127.0.0.1", 0), _EchoHandler,
+                               pool_size=2, acceptors=2)
+        st = _start(srv)
+        try:
+            assert isinstance(srv.reuseport, bool)
+            if probe_reuseport():
+                assert srv.reuseport
+            for _ in range(4):
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.server_address[1]}/",
+                    timeout=10,
+                ).read()
+                assert body.decode().startswith("tffm-http-worker-")
+        finally:
+            srv.shutdown()
+            st.join(timeout=10)
+            srv.server_close()
+        assert _no_pool_threads() == []
+
+
+# ----------------------------------------------------------------------
+# through the serving stack: pooled mount, rid minting, router smoke
+# ----------------------------------------------------------------------
+
+
+_CFG_KW = dict(
+    vocabulary_size=64, factor_num=4, max_features=4,
+    serve_batch_sizes="8", max_batch_wait_ms=1.0,
+)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    import jax
+
+    from fast_tffm_tpu.models import fm
+    from fast_tffm_tpu.serve.batcher import ServeBatcher
+    from fast_tffm_tpu.serve.scorer import FixedShapeScorer
+
+    tmp = tmp_path_factory.mktemp("pooled_http")
+    cfg = FmConfig(model_file=str(tmp / "model"), **_CFG_KW)
+    params = jax.jit(
+        lambda k: fm.init_params(k, cfg=cfg)
+    )(jax.random.PRNGKey(0))
+    scorer = FixedShapeScorer(cfg, params)
+    scorer.warmup()
+    batcher = ServeBatcher(
+        scorer, max_batch_wait_ms=cfg.max_batch_wait_ms
+    )
+    yield cfg, scorer, batcher
+    batcher.close()
+
+
+class TestServeServerPooled:
+    def test_pooled_mount_is_default(self, stack):
+        from fast_tffm_tpu.serve.server import ServeServer
+
+        cfg, scorer, batcher = stack
+        server = ServeServer(
+            0, batcher, cfg, lambda: {"record": "status"}
+        )
+        try:
+            assert isinstance(server._httpd, PooledHTTPServer)
+            assert server._httpd.pool_size == cfg.serve_http_threads
+            body = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/score",
+                data=b"0 1:0.5 2:0.25\n", method="POST",
+            ), timeout=30).read()
+            assert len(body.decode().splitlines()) == 1
+        finally:
+            server.close()
+        assert _no_pool_threads() == []
+
+    def test_zero_threads_mounts_legacy_server(self, stack):
+        import dataclasses
+
+        from fast_tffm_tpu.serve.server import ServeServer
+
+        cfg, scorer, batcher = stack
+        lcfg = dataclasses.replace(cfg, serve_http_threads=0)
+        server = ServeServer(
+            0, batcher, lcfg, lambda: {"record": "status"}
+        )
+        try:
+            assert isinstance(server._httpd, ObsHTTPServer)
+            assert not isinstance(server._httpd, PooledHTTPServer)
+            body = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/score",
+                data=b"0 1:0.5 2:0.25\n", method="POST",
+            ), timeout=30).read()
+            assert len(body.decode().splitlines()) == 1
+        finally:
+            server.close()
+
+    def test_pooled_and_legacy_score_byte_identical(self, stack):
+        import dataclasses
+
+        from fast_tffm_tpu.serve.server import ServeServer
+
+        cfg, scorer, batcher = stack
+        body = b"0 1:0.5 2:0.25\n1 3:1.0\n0 5:0.125 7:0.75 9:1\n"
+        lcfg = dataclasses.replace(
+            cfg, serve_http_threads=0, serve_parse_mode="legacy"
+        )
+        outs = []
+        for c in (cfg, lcfg):
+            server = ServeServer(
+                0, batcher, c, lambda: {"record": "status"}
+            )
+            try:
+                outs.append(urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{server.port}/score",
+                        data=body, method="POST",
+                    ), timeout=30).read())
+            finally:
+                server.close()
+        assert outs[0] == outs[1]
+
+    def test_concurrent_rid_mint_unique(self, stack):
+        """Sampled requests minted from concurrent pool workers carry
+        UNIQUE X-Request-Id values — the itertools.count mint holds
+        under the pooled front end's concurrency."""
+        import dataclasses
+
+        from fast_tffm_tpu import obs
+        from fast_tffm_tpu.serve.server import ServeServer
+
+        cfg, scorer, batcher = stack
+        tcfg = dataclasses.replace(
+            cfg, serve_trace_sample=1.0,
+            trace_file=cfg.model_file + ".trace.json",
+        )
+        tracer = obs.Tracer(enabled=True, process_name="pooled-test")
+        server = ServeServer(
+            0, batcher, tcfg, lambda: {"record": "status"},
+            tracer=tracer,
+        )
+        try:
+            rids: list = []
+            lock = threading.Lock()
+            errs: list = []
+
+            def hit():
+                try:
+                    for _ in range(8):
+                        resp = urllib.request.urlopen(
+                            urllib.request.Request(
+                                f"http://127.0.0.1:{server.port}"
+                                f"/score",
+                                data=b"0 1:0.5\n", method="POST",
+                            ), timeout=30)
+                        resp.read()
+                        rid = resp.headers.get("X-Request-Id")
+                        with lock:
+                            rids.append(rid)
+                except Exception as e:  # noqa: BLE001 - surface below
+                    errs.append(e)
+
+            ts = [threading.Thread(target=hit) for _ in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            assert len(rids) == 32
+            assert all(r for r in rids)
+            assert len(set(rids)) == 32
+        finally:
+            server.close()
+            tracer.close()
+
+    def test_router_smoke_through_pooled_front_ends(self, stack):
+        """Router -> replica with BOTH mounts pooled (the new
+        default): scores round-trip and match the direct server."""
+        from fast_tffm_tpu.serve.router import Replica, ServeRouter
+        from fast_tffm_tpu.serve.server import ServeServer
+
+        cfg, scorer, batcher = stack
+        server = ServeServer(
+            0, batcher, cfg, lambda: {"record": "status"}
+        )
+        router = None
+        try:
+            router = ServeRouter(
+                0, [Replica(0, "127.0.0.1", server.port)], cfg,
+                health_secs=10.0,
+            )
+            assert isinstance(router._httpd, PooledHTTPServer)
+            body = b"0 1:0.5 2:0.25\n1 3:1.0\n"
+            direct = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/score",
+                data=body, method="POST",
+            ), timeout=30).read()
+            routed = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/score",
+                data=body, method="POST",
+            ), timeout=30).read()
+            assert routed == direct
+        finally:
+            if router is not None:
+                router.close()
+            server.close()
+        assert _no_pool_threads() == []
+
+    def test_scratch_pool_drains_after_traffic(self, stack):
+        """Every request's parse-scratch lease is released once its
+        batch dispatches — steady traffic leaves zero leased buffers
+        behind (the on_done lifecycle end to end over HTTP)."""
+        from fast_tffm_tpu.serve.server import ServeServer
+
+        cfg, scorer, batcher = stack
+        server = ServeServer(
+            0, batcher, cfg, lambda: {"record": "status"}
+        )
+        try:
+            for i in range(12):
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}/score",
+                    data=f"0 {i}:0.5 {i + 1}:0.25\n".encode(),
+                    method="POST",
+                ), timeout=30).read()
+            deadline = time.time() + 10
+            while time.time() < deadline and server.parse_pool.leased:
+                time.sleep(0.05)
+            assert server.parse_pool.leased == 0
+        finally:
+            server.close()
+

@@ -777,6 +777,14 @@ class TestEndToEnd:
         assert "run_header" in kinds and "final" in kinds
         header = next(r for r in recs if r["record"] == "run_header")
         assert header["mode"] == "serve"
+        # ISSUE 16: the accept-path shape is reconstructable from any
+        # metrics stream (KD discipline for the new front-end knobs).
+        assert header["serve_parse_mode"] == scfg.serve_parse_mode
+        assert header["serve_http_threads"] == scfg.serve_http_threads
+        assert (
+            header["serve_http_acceptors"] == scfg.serve_http_acceptors
+        )
+        assert header["serve_request_queue_size"] >= 1
         final = next(r for r in recs if r["record"] == "final")
         assert final["serve"]["requests"] >= 1
         flat = report._comparable_metrics(str(stream))

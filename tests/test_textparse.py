@@ -1,0 +1,278 @@
+"""Vectorized request parsing (ISSUE 16 tentpole): the batch parser is
+BITWISE-IDENTICAL to the legacy per-line loop — arrays, dtypes,
+truncation counts, AND error text (the fast path falls back to the
+legacy parser on any out-of-grammar input, so the legacy behavior is
+the contract by construction) — and the scratch pool recycles the
+per-request arrays without ever handing out a dirty buffer.
+
+jax-free: textparse.py imports numpy and the hash oracle only.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.serve import textparse
+from fast_tffm_tpu.serve.textparse import ParseScratchPool, parse_request
+
+
+def _cfg(**kw):
+    base = dict(vocabulary_size=1000, factor_num=4, max_features=39)
+    base.update(kw)
+    return FmConfig(**base)
+
+
+# Every accepted-grammar corner plus every rejection the legacy loop
+# attributes to a line: labels (signed/float/inf/nan), label-less
+# lines, bare ids, comments/blanks, truncation, ffm tokens, mixed
+# shapes, signed ids, >18-digit ids (valid input that must take the
+# fallback), hash-only alpha/unicode ids, malformed tokens.
+BODIES = [
+    "0 1:0.5 2:1.5\n1 3:2.0\n",
+    "1:0.5 2:1.5\n3:2.0\n",
+    "0 5 7 9\n",
+    "# comment\n\n0 1:1.0\n   \n",
+    "0 " + " ".join(f"{i}:{i}.25" for i in range(50)) + "\n",
+    "0 1:0.5\nbogus::3\n",
+    "0 1:0.5 2:x\n",
+    "",
+    "\n# only\n\n",
+    "0\n1\n",
+    "-1 1:0.5\n+0.5 2:1\n",
+    "inf 3:nan\nnan 4:inf\n",
+    "0 1:1e-3 2:1E3 3:.5 4:5. 5:+.5e+2\n",
+    "0 -5:0.5 +7:1.5\n",
+    "0 " + str(10 ** 25) + ":1.0\n",
+    "0 1:0.5 2\n",
+    "0 1:2:0.5 3:4:1.5\n",
+    "0 1:2:0.5 3:1.5 4\n",
+    "0 :5\n",
+    "0 a:0.5 b:1.5\n",
+    "0 ü:1.0 café:2.0\n",
+    "0 1:0.5 2:1.5\n\n1 3:2.0 4:4.0 5:1\n",
+    "0 1:0.5 2:1.5\n1 3:2.0 4:4.0\n",
+]
+
+
+def _run(fn, body, cfg):
+    try:
+        return ("ok",) + tuple(fn(body, cfg, None))
+    except ValueError as e:
+        return ("err", str(e))
+
+
+def _assert_same(a, b, ctx):
+    assert a[0] == b[0], ctx
+    if a[0] == "err":
+        # Error TEXT parity, not just the raise: the 400 body names
+        # the line either way.
+        assert a[1] == b[1], ctx
+        return
+    _, i1, v1, f1, n1, t1 = a
+    _, i2, v2, f2, n2, t2 = b
+    assert (n1, t1) == (n2, t2), ctx
+    for x, y in ((i1, i2), (v1, v2), (f1, f2)):
+        assert x.dtype == y.dtype and x.shape == y.shape, ctx
+        # tobytes(): bitwise, and nan-safe where array_equal is not.
+        assert x.tobytes() == y.tobytes(), ctx
+
+
+class TestVecLegacyParity:
+    @pytest.mark.parametrize("field_num", [0, 3])
+    @pytest.mark.parametrize("hash_mode", [False, True])
+    def test_edge_matrix_bitwise(self, field_num, hash_mode):
+        cfg = _cfg(field_num=field_num, hash_feature_id=hash_mode)
+        for body in BODIES:
+            a = _run(textparse._parse_legacy, body, cfg)
+            b = _run(
+                lambda t, c, p: parse_request(t, c, p), body, cfg
+            )
+            _assert_same(a, b, (body[:60], field_num, hash_mode))
+
+    @pytest.mark.parametrize("size", [1, 2, 4, 8, 16, 64])
+    def test_production_shapes_bitwise(self, size):
+        rng = random.Random(7)
+        body = "".join(
+            "0 " + " ".join(
+                f"{rng.randrange(1000)}:{rng.random():.3f}"
+                for _ in range(12)
+            ) + "\n"
+            for _ in range(size)
+        )
+        for fn in (0, 3):
+            cfg = _cfg(field_num=fn)
+            _assert_same(
+                _run(textparse._parse_legacy, body, cfg),
+                _run(lambda t, c, p: parse_request(t, c, p), body,
+                     cfg),
+                (size, fn),
+            )
+        ffm = "".join(
+            "1 " + " ".join(
+                f"{rng.randrange(3)}:{rng.randrange(1000)}"
+                f":{rng.random():.3f}"
+                for _ in range(12)
+            ) + "\n"
+            for _ in range(size)
+        )
+        cfg = _cfg(field_num=3)
+        _assert_same(
+            _run(textparse._parse_legacy, ffm, cfg),
+            _run(lambda t, c, p: parse_request(t, c, p), ffm, cfg),
+            ("ffm", size),
+        )
+
+    def test_ragged_lines_bitwise(self):
+        body = "0 1:0.5\n1 2:0.25 3:0.75 4:1.0\n0 5:0.5 6:0.5\n"
+        cfg = _cfg(field_num=3)
+        _assert_same(
+            _run(textparse._parse_legacy, body, cfg),
+            _run(lambda t, c, p: parse_request(t, c, p), body, cfg),
+            "ragged",
+        )
+
+    def test_malformed_line_number_in_error(self):
+        cfg = _cfg()
+        with pytest.raises(ValueError, match="line 4"):
+            parse_request("# c\n0 1:0.5\n\n0 2:oops\n", cfg)
+        # Identical text from the forced-legacy engine.
+        lcfg = _cfg(serve_parse_mode="legacy")
+        try:
+            parse_request("# c\n0 1:0.5\n\n0 2:oops\n", cfg)
+        except ValueError as e_vec:
+            with pytest.raises(ValueError) as e_leg:
+                parse_request("# c\n0 1:0.5\n\n0 2:oops\n", lcfg)
+            assert str(e_vec) == str(e_leg.value)
+
+    def test_truncation_counts_match(self):
+        cfg = _cfg(max_features=4)
+        wide = (
+            "0 " + " ".join(f"{i}:0.5" for i in range(9)) + "\n"
+            "1 2:1.0\n"
+        )
+        *_, n_v, t_v = parse_request(wide, cfg)
+        *_, n_l, t_l = textparse._parse_legacy(wide, cfg, None)
+        assert (n_v, t_v) == (n_l, t_l) == (2, 5)
+
+    def test_serve_parse_mode_legacy_forces_oracle(self, monkeypatch):
+        cfg = _cfg(serve_parse_mode="legacy")
+
+        def boom(*a, **k):  # the vec engine must not run at all
+            raise AssertionError("vec path ran under legacy mode")
+
+        monkeypatch.setattr(textparse, "_parse_vec", boom)
+        ids, vals, fields, n, t = parse_request("0 1:0.5\n", cfg)
+        assert n == 1 and ids[0, 0] == 1
+
+    def test_fallback_reaches_legacy_on_out_of_grammar(
+        self, monkeypatch
+    ):
+        """A >18-digit id is VALID legacy input outside the vec
+        grammar: the vec engine must decline and the legacy result
+        come back unchanged."""
+        cfg = _cfg()
+        called = []
+        orig = textparse._parse_legacy
+
+        def spy(text, c, pool):
+            called.append(text)
+            return orig(text, c, pool)
+
+        monkeypatch.setattr(textparse, "_parse_legacy", spy)
+        big = 10 ** 25
+        ids, *_ = parse_request(f"0 {big}:1.0\n", cfg)
+        assert called, "vec path did not fall back"
+        assert ids[0, 0] == big % cfg.vocabulary_size
+
+
+class TestScratchPool:
+    def test_reuse_and_zero_fill(self):
+        pool = ParseScratchPool(39)
+        cfg = _cfg()
+        ids1, vals1, _, n, _ = parse_request(
+            "0 1:0.5 2:1.5\n", cfg, pool
+        )
+        base1 = ids1.base
+        assert base1 is not None and pool.leased == 1
+        pool.release(ids1)
+        assert pool.leased == 0
+        ids2, vals2, _, n, _ = parse_request("0 3:9.5\n", cfg, pool)
+        # Same backing buffer, re-zeroed: slot 1 held 2:1.5 before.
+        assert ids2.base is base1
+        assert ids2[0, 1] == 0 and vals2[0, 1] == 0.0
+        pool.release(ids2)
+
+    def test_double_release_is_noop(self):
+        pool = ParseScratchPool(8)
+        ids, _, _ = pool.acquire(2)
+        pool.release(ids)
+        pool.release(ids)  # must not corrupt the free list
+        assert pool.leased == 0
+        a1, _, _ = pool.acquire(2)
+        a2, _, _ = pool.acquire(2)
+        assert a1.base is not a2.base
+        pool.release(a1)
+        pool.release(a2)
+
+    def test_untracked_release_is_noop(self):
+        pool = ParseScratchPool(8)
+        pool.release(np.zeros((2, 8), np.int32))
+        assert pool.leased == 0
+
+    def test_oversized_requests_bypass_pool(self):
+        pool = ParseScratchPool(8, max_pooled_rows=4)
+        ids, vals, fields = pool.acquire(16)
+        assert pool.leased == 0  # untracked fresh arrays
+        pool.release(ids)
+
+    def test_error_path_releases_lease(self):
+        pool = ParseScratchPool(8)
+        cfg = _cfg()
+        with pytest.raises(ValueError):
+            parse_request("0 1:0.5\n0 2:bad\n", cfg, pool)
+        assert pool.leased == 0
+
+    def test_telemetry_counters(self):
+        from fast_tffm_tpu import obs
+
+        tel = obs.Telemetry()
+        pool = ParseScratchPool(8, telemetry=tel)
+        a, _, _ = pool.acquire(2)
+        pool.release(a)
+        b, _, _ = pool.acquire(2)
+        pool.release(b)
+        snap = tel.snapshot()
+        assert snap["counters"].get("serve.parse_scratch_reuse") == 1
+        assert snap["gauges"].get("serve.parse_scratch_bytes", 0) > 0
+
+    def test_concurrent_acquire_release(self):
+        import threading
+
+        pool = ParseScratchPool(8)
+        cfg = _cfg()
+        errs: list = []
+
+        def worker(seed):
+            try:
+                for i in range(50):
+                    ids, *_ = parse_request(
+                        f"0 {seed + i}:0.5\n", cfg, pool
+                    )
+                    assert ids[0, 0] == (seed + i) % 1000
+                    pool.release(ids)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        ts = [
+            threading.Thread(target=worker, args=(100 * i,))
+            for i in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs and pool.leased == 0

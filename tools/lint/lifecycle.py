@@ -38,6 +38,12 @@ through):
 - ``threads = [Thread(...) ...]`` / ``threads += [...]`` /
   ``lst.append(Thread(...))``: some loop/comprehension over that
   container must call ``.join()`` on the loop variable.
+- ``self.workers = [Thread(...) for ...]`` (rule TL007, the
+  worker-pool shape PooledHTTPServer introduced): some
+  loop/comprehension over ``self.workers`` ANYWHERE in the class must
+  call the teardown on the loop variable — pooled handler threads need
+  a reachable join on the server's shutdown path, same class of leak
+  as TL005/TL006.
 - ``threading.Thread(...).start()`` with the object never bound:
   nothing can EVER join it — always a finding.
 """
@@ -58,6 +64,8 @@ _RESOURCES = {
     "SharedMemory": ("TL003", "SHM segment", ("close", "unlink")),
     "ThreadingHTTPServer": ("TL004", "HTTP server", ("shutdown",)),
     "HTTPServer": ("TL004", "HTTP server", ("shutdown",)),
+    "ObsHTTPServer": ("TL004", "HTTP server", ("shutdown",)),
+    "PooledHTTPServer": ("TL004", "HTTP server", ("shutdown",)),
     "Popen": ("TL006", "subprocess", ("wait", "terminate", "kill")),
 }
 
@@ -131,7 +139,8 @@ def _container_teardown(node, container, teardowns) -> bool:
 
 class LifecycleRule:
     name = "lifecycle"
-    rule_ids = ("TL001", "TL002", "TL003", "TL004", "TL005", "TL006")
+    rule_ids = ("TL001", "TL002", "TL003", "TL004", "TL005", "TL006",
+                "TL007")
 
     def run(self, ctx: Context):
         findings = []
@@ -154,8 +163,7 @@ class LifecycleRule:
             for node in ast.walk(cls):
                 if not isinstance(node, ast.Assign):
                     continue
-                info = _ctor(node.value)
-                if info is None or len(node.targets) != 1:
+                if len(node.targets) != 1:
                     continue
                 tgt = node.targets[0]
                 if not (
@@ -164,25 +172,68 @@ class LifecycleRule:
                     and tgt.value.id == "self"
                 ):
                     continue
-                rule, kind, teardowns = info
-                if teardowns not in torn:
-                    torn[teardowns] = _teardown_calls(cls, teardowns)
-                if f"self.{tgt.attr}" not in torn[teardowns]:
-                    findings.append(Finding(
-                        rule=rule, path=rel, line=node.value.lineno,
-                        message=(
-                            f"{kind} `self.{tgt.attr}` created in "
-                            f"{cls.name} has no reachable "
-                            f"{'/'.join(teardowns)} anywhere in the "
-                            "class"
-                        ),
-                        hint=(
-                            f"call `self.{tgt.attr}."
-                            f"{teardowns[0]}()` on the owner's "
-                            "close()/teardown path"
-                        ),
-                        symbol=f"{cls.name}.{tgt.attr}",
-                    ))
+                info = _ctor(node.value)
+                if info is not None:
+                    rule, kind, teardowns = info
+                    if teardowns not in torn:
+                        torn[teardowns] = _teardown_calls(cls, teardowns)
+                    if f"self.{tgt.attr}" not in torn[teardowns]:
+                        findings.append(Finding(
+                            rule=rule, path=rel, line=node.value.lineno,
+                            message=(
+                                f"{kind} `self.{tgt.attr}` created in "
+                                f"{cls.name} has no reachable "
+                                f"{'/'.join(teardowns)} anywhere in "
+                                "the class"
+                            ),
+                            hint=(
+                                f"call `self.{tgt.attr}."
+                                f"{teardowns[0]}()` on the owner's "
+                                "close()/teardown path"
+                            ),
+                            symbol=f"{cls.name}.{tgt.attr}",
+                        ))
+                    continue
+                # TL007 — the worker-pool shape: a CONTAINER of
+                # tracked resources bound to a self attribute
+                # (``self._workers = [Thread(...) for ...]``).  The
+                # function-scope container pass cannot see these (the
+                # teardown loop lives in ANOTHER method, usually
+                # close()/server_close()), so the class is the scope:
+                # some loop/comprehension over ``self.attr`` must tear
+                # each element down.
+                if isinstance(node.value, (ast.List, ast.ListComp)):
+                    elts = (
+                        node.value.elts
+                        if isinstance(node.value, ast.List)
+                        else [node.value.elt]
+                    )
+                    for e in elts:
+                        info = _ctor(e)
+                        if info is None:
+                            continue
+                        _, kind, teardowns = info
+                        if _container_teardown(
+                            cls, f"self.{tgt.attr}", teardowns
+                        ):
+                            continue
+                        findings.append(Finding(
+                            rule="TL007", path=rel, line=e.lineno,
+                            message=(
+                                f"{kind}s collected into "
+                                f"`self.{tgt.attr}` in {cls.name} are "
+                                f"never {'/'.join(teardowns)}ed (no "
+                                f"loop over `self.{tgt.attr}` "
+                                "anywhere in the class tears them "
+                                "down)"
+                            ),
+                            hint=(
+                                f"`for t in self.{tgt.attr}: "
+                                f"t.{teardowns[0]}()` on the owner's "
+                                "close()/teardown path"
+                            ),
+                            symbol=f"{cls.name}.{tgt.attr}[]",
+                        ))
             # Local bindings inside methods are handled by the
             # function-scope pass below (function_scopes covers them).
         # Function-scope locals + containers + unbound starts.

@@ -30,7 +30,12 @@ checkpoint that run just wrote — ``run_tffm.py serve`` in a subprocess:
 8b. training→serving skew END TO END: identity traffic (lines from
    the training file) reads stable against the manifest's training
    sketches, and a shifted request population (foreign ids, 100x
-   values) breaches ``tffm_serve_skew_psi_max`` > 0.25 on /metrics.
+   values) breaches ``tffm_serve_skew_psi_max`` > 0.25 on /metrics;
+8c. request hot path (ISSUE 16): the pooled-accept + vectorized-parse
+   defaults answer BYTE-IDENTICALLY to a second server mounted with
+   ``--serve_http_threads 0 --serve_parse_mode legacy``, and an
+   in-process ``PooledHTTPServer`` start/score/close cycle leaks no
+   worker or acceptor threads.
 
 Then the ROUTER smoke (scale-out serving, SERVING.md "Scale-out") —
 ``run_tffm.py serve --replicas 2`` in a subprocess, with per-request
@@ -375,11 +380,113 @@ def check_serve(cfg_path: str, data: str) -> None:
                 f"tffm_serve_skew_psi_max (got "
                 f"{m.group(1) if m else 'no series'})"
             )
+        # Request hot path (ISSUE 16): the pooled-accept + vectorized
+        # parser stack (the defaults above) must be byte-identical on
+        # the wire to the legacy thread-per-connection +
+        # per-line-parser stack.  Second serve subprocess on the same
+        # model dir with both knobs flipped, same request body,
+        # compare responses byte for byte.
+        l_port = _free_port()
+        l_proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "run_tffm.py"),
+             "serve", cfg_path, "--serve_port", str(l_port),
+             "--serve_poll_secs", "0.2",
+             "--serve_http_threads", "0",
+             "--serve_parse_mode", "legacy"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        try:
+            l_base = f"http://127.0.0.1:{l_port}"
+            deadline = time.time() + 120
+            while True:
+                try:
+                    urllib.request.urlopen(
+                        f"{l_base}/healthz", timeout=2)
+                    break
+                except (urllib.error.URLError, OSError) as e:
+                    if l_proc.poll() is not None:
+                        out, _ = l_proc.communicate()
+                        sys.stderr.write(
+                            out.decode(errors="replace")[-2000:]
+                        )
+                        raise SystemExit(
+                            f"FAIL: legacy-mode serve exited "
+                            f"{l_proc.returncode} before answering "
+                            f"({e})"
+                        )
+                    if time.time() > deadline:
+                        raise SystemExit(
+                            f"FAIL: legacy-mode serve unreachable ({e})"
+                        )
+                    time.sleep(0.2)
+            pooled_body = urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/score", data=lines.encode(), method="POST"
+                ), timeout=30).read()
+            legacy_body = urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{l_base}/score", data=lines.encode(),
+                    method="POST"
+                ), timeout=30).read()
+            if pooled_body != legacy_body:
+                raise SystemExit(
+                    "FAIL: pooled/vec serve stack is not "
+                    "byte-identical to the legacy accept+parser: "
+                    f"{pooled_body[:100]!r} vs {legacy_body[:100]!r}"
+                )
+        finally:
+            if l_proc.poll() is None:
+                l_proc.terminate()
+                try:
+                    l_proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    l_proc.kill()
+                    l_proc.wait()
+        # ISSUE 16: pooled server teardown must leak no worker or
+        # acceptor thread — in-process so the thread set is ours to
+        # enumerate.
+        from http.server import BaseHTTPRequestHandler
+
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from fast_tffm_tpu.obs.status import PooledHTTPServer
+
+        class _NoopHandler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API name
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def log_message(self, *args):
+                pass
+
+        hs = PooledHTTPServer(("127.0.0.1", 0), _NoopHandler,
+                              pool_size=4, acceptors=2)
+        st = threading.Thread(target=hs.serve_forever, daemon=True)
+        st.start()
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{hs.server_address[1]}/", timeout=10
+        ).read()
+        hs.shutdown()
+        st.join(timeout=10)
+        hs.server_close()
+        leaked = [
+            t.name for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("tffm-http-")
+        ]
+        if leaked:
+            raise SystemExit(
+                f"FAIL: PooledHTTPServer teardown leaked threads: "
+                f"{leaked}"
+            )
         print(f"serve smoke ok: scored 10/10 over the socket, "
               f"tffm_serve_* series present, {swaps} hot-swap(s) "
               f"mid-traffic, skew breach visible "
               f"(tffm_serve_skew_psi_max {float(m.group(1)):.2f} "
-              f"after shifted traffic)")
+              f"after shifted traffic), pooled==legacy byte-identical, "
+              f"pooled teardown leaked 0 threads")
     finally:
         if proc.poll() is None:
             proc.terminate()

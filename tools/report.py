@@ -1077,6 +1077,19 @@ _DIRECTION_OVERRIDES = {
     # informational: it should only ever burn DOWN, but shrinking it
     # must never flag, so no direction.
     "lint_findings_new": "low", "lint_findings_baselined": None,
+    # Serve hot path (ISSUE 16): the text-parse p50 and the vectorized
+    # parser's speedup over the legacy per-line loop gate the request
+    # hot path (parse time regresses when it RISES, the speedup when
+    # it FALLS below ~1).  The pooled-accept toggle keys are
+    # informational: which accept model ran, its worker count, and the
+    # paired legacy-accept window (pooled_x is box-sensitive on small
+    # hosts — the gated axis is serve_qps itself).
+    "serve_parse_p50_ms": "low", "serve.parse_p50_ms": "low",
+    "serve_parse_vec_speedup": "high",
+    "serve_accept_pooled": None, "serve_accept_pooled_x": None,
+    "serve_qps_legacy_accept": None, "serve_http_threads": None,
+    "serve.parse_scratch_reuse": None,
+    "serve.parse_scratch_bytes": None,
 }
 
 
