@@ -1662,6 +1662,10 @@ def main() -> int:
     e2e_resource_on = 0.0
     e2e_quality_on = 0.0
     bench_compile_s = 0.0
+    autotune_rate_auto, autotune_rate_ref = 0.0, 0.0
+    autotune_kernel_impl, autotune_times = "", {}
+    compile_s_cold, compile_s_warm = 0.0, 0.0
+    compile_cache_hits = -1
     bf16_rung, bf16_errors = None, []
     e2e_err = None
     cfg = None
@@ -1674,7 +1678,7 @@ def main() -> int:
         workers = min(16, max(4, (os.cpu_count() or 4) - 2))
 
         def make_cfg(**overrides):
-            c = FmConfig(
+            kw = dict(
                 vocabulary_size=1 << 22 if on_tpu else 1 << 20,
                 factor_num=8,
                 max_features=39,
@@ -1689,8 +1693,9 @@ def main() -> int:
                 # in-flight bound so warmup can't pre-parse the measured
                 # region either way).
                 queue_size=workers,
-                **overrides,
             )
+            kw.update(overrides)
+            c = FmConfig(**kw)
             shutil.rmtree(c.model_file, ignore_errors=True)
             return c
 
@@ -1875,6 +1880,130 @@ def main() -> int:
                     except Exception as e:  # noqa: BLE001 - report only
                         ladder_errors.append(
                             f"quality probe: {type(e).__name__}: {e}"
+                        )
+                    # Kernel-autotune overhead probe (ISSUE 17),
+                    # PAIRED: the identical K=8 step-scan through a
+                    # trainer resolved via interaction_impl=auto vs
+                    # one PINNED to reference, interleaved rounds.  On
+                    # CPU auto collapses to reference at init (single
+                    # candidate, zero measurement), so the two steady
+                    # states run the same executable and the ratio
+                    # prices exactly the autotuner's footprint —
+                    # budget <= 1.05.  On TPU the ratio instead shows
+                    # what the measured promotion buys (< 1.0 when a
+                    # non-reference impl wins).  The probe keeps the
+                    # autotune cache in memory only so a bench never
+                    # leaves autotune_cache.json next to the
+                    # throwaway /tmp model dir.
+                    try:
+                        _env_prev = os.environ.get(
+                            "FAST_TFFM_AUTOTUNE_CACHE"
+                        )
+                        os.environ["FAST_TFFM_AUTOTUNE_CACHE"] = ""
+                        try:
+                            # Own model dirs: make_cfg rmtree's its
+                            # model_file, and sharing one dir would
+                            # both delete the main trainer's and make
+                            # the second probe trainer restore the
+                            # first's checkpoint.
+                            c_auto = make_cfg(
+                                interaction_impl="auto",
+                                model_file=os.path.join(
+                                    tmpdir, "autotune_m_auto"
+                                ),
+                            )
+                            c_ref = make_cfg(
+                                interaction_impl="reference",
+                                model_file=os.path.join(
+                                    tmpdir, "autotune_m_ref"
+                                ),
+                            )
+                            t_auto = Trainer(c_auto)
+                            t_ref = Trainer(c_ref)
+                            autotune_kernel_impl = t_auto.kernel_impl
+                            if t_auto._autotune is not None:
+                                autotune_times = dict(
+                                    t_auto._autotune.times_ms
+                                )
+                            a_samples, p_samples = [], []
+                            for _ in range(rounds):
+                                a_samples.append(_bench_step_scan(
+                                    t_auto, c_auto, max(steps, 2 * K), K
+                                ))
+                                p_samples.append(_bench_step_scan(
+                                    t_ref, c_ref, max(steps, 2 * K), K
+                                ))
+                            autotune_rate_auto = float(
+                                np.median(a_samples)
+                            )
+                            autotune_rate_ref = float(
+                                np.median(p_samples)
+                            )
+                            del t_auto, t_ref
+                        finally:
+                            if _env_prev is None:
+                                os.environ.pop(
+                                    "FAST_TFFM_AUTOTUNE_CACHE", None
+                                )
+                            else:
+                                os.environ[
+                                    "FAST_TFFM_AUTOTUNE_CACHE"
+                                ] = _env_prev
+                    except Exception as e:  # noqa: BLE001 - report only
+                        ladder_errors.append(
+                            f"autotune probe: {type(e).__name__}: {e}"
+                        )
+                    # Persistent-compile-cache probe (ISSUE 17): time
+                    # one nontrivial AOT compile cold (fresh cache
+                    # dir, miss) then again from a structurally
+                    # identical fresh jit (persistent-cache hit) —
+                    # warm vs cold compile_s is the restart/replica
+                    # saving the compile_cache_dir knob buys.  The
+                    # cache dir and jax config are restored after so
+                    # later probes compile exactly as before.
+                    try:
+                        from fast_tffm_tpu import platform as _platform
+                        import jax as _jax
+                        import jax.numpy as _jnp
+
+                        cc_dir = tempfile.mkdtemp(
+                            prefix="fast_tffm_bench_cc_"
+                        )
+                        try:
+                            _platform.enable_compile_cache(cc_dir)
+                            st0 = _platform.compile_cache_stats()
+
+                            def _cc_probe_fn():
+                                # Fresh function object per call: same
+                                # jaxpr (one persistent-cache key),
+                                # but a new jit so nothing in-process
+                                # memoizes the executable.
+                                def f(x):
+                                    y = _jnp.tanh(x @ x.T)
+                                    return _jnp.sum(y * y, axis=-1)
+
+                                return _jax.jit(f)
+
+                            struct = _jax.ShapeDtypeStruct(
+                                (256, 256), _jnp.float32
+                            )
+                            t0c = time.perf_counter()
+                            _cc_probe_fn().lower(struct).compile()
+                            compile_s_cold = time.perf_counter() - t0c
+                            t0w = time.perf_counter()
+                            _cc_probe_fn().lower(struct).compile()
+                            compile_s_warm = time.perf_counter() - t0w
+                            st1 = _platform.compile_cache_stats()
+                            compile_cache_hits = (
+                                st1["hits"] - st0["hits"]
+                            )
+                        finally:
+                            _platform.disable_compile_cache()
+                            shutil.rmtree(cc_dir, ignore_errors=True)
+                    except Exception as e:  # noqa: BLE001 - report only
+                        ladder_errors.append(
+                            f"compile cache probe: "
+                            f"{type(e).__name__}: {e}"
                         )
                     # Compile-sentinel attribution for the BENCH JSON:
                     # total train-step compile wall time this bench's
@@ -2064,6 +2193,28 @@ def main() -> int:
         # sentinel accounted.  --compare gates both (low).
         "peak_rss_mb": round(obs_mod.read_rss()[1] / (1 << 20), 1),
         "compile_s": round(bench_compile_s, 3),
+        # Kernel autotuner (ISSUE 17): which interaction impl `auto`
+        # promoted for this backend/shape (informational — a string,
+        # so --compare skips it), the per-candidate measurement
+        # medians when a measurement ran (empty dict on CPU where
+        # reference wins by single-candidate), and the paired
+        # steady-state ratio reference/auto — the autotuner's whole
+        # footprint, budget <= 1.05 (< 1.0 on TPU means the promoted
+        # impl is actually faster).
+        "kernel_impl": autotune_kernel_impl,
+        "autotune_overhead": round(
+            autotune_rate_ref / autotune_rate_auto, 4
+        ) if autotune_rate_auto > 0 and autotune_rate_ref > 0 else 0.0,
+        "autotune_times_ms": autotune_times,
+        # Persistent compile cache: the same nontrivial jit compiled
+        # cold (fresh cache dir, disk miss) vs from a fresh function
+        # object with the persistent entry warm — warm/cold is the
+        # per-executable restart saving compile_cache_dir buys.
+        # compile_cache_hits counts the persistent-cache hit events
+        # the warm compile produced (-1 = probe didn't run).
+        "compile_s_cold": round(compile_s_cold, 4),
+        "compile_s_warm": round(compile_s_warm, 4),
+        "compile_cache_hits": compile_cache_hits,
         "parse_lines_per_sec": round(parse_rate, 1),
         # Bare-pipeline drain rates: thread workers vs a spawned
         # parse-process pool on the same files (GIL-free scaling probe).

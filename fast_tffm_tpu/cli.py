@@ -295,6 +295,21 @@ def build_argparser() -> argparse.ArgumentParser:
              "shared-socket fallback otherwise)",
     )
     p.add_argument(
+        "--interaction_impl", default=None,
+        choices=["auto", "reference", "pallas", "packed"],
+        help="device interaction path for the FM hot loop: 'auto' "
+             "benchmarks the candidates for this run's shapes and "
+             "promotes the fastest that matches reference (decision "
+             "cached in autotune_cache.json); a named impl pins it "
+             "with no measurement",
+    )
+    p.add_argument(
+        "--compile_cache_dir", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory: restarts "
+             "and replica spawns replay their warmup compiles from "
+             "disk instead of re-lowering (empty = off)",
+    )
+    p.add_argument(
         "--metrics_file", default=None, metavar="PATH",
         help="JSONL metrics stream path (overrides the cfg; a "
              "multi-replica fleet suffixes each replica's stream "
@@ -357,7 +372,8 @@ def main(argv=None) -> int:
                     "serve_transport", "serve_trace_sample",
                     "serve_slo_p99_ms", "serve_slo_availability",
                     "serve_parse_mode", "serve_http_threads",
-                    "serve_http_acceptors",
+                    "serve_http_acceptors", "interaction_impl",
+                    "compile_cache_dir",
                     "quality_window", "metrics_file")
         if getattr(args, key) is not None
     }

@@ -345,6 +345,24 @@ class FmConfig:
     # always uses its closed-form op (ops.interaction.ffm_interaction;
     # FAST_TFFM_FFM_AUTODIFF=1 forces the autodiff einsum oracle).
     interaction: str = ""
+    # Kernel autotuner surface (ops/autotune.py): "auto" benchmarks the
+    # candidate interaction implementations at the run's actual shapes,
+    # parity-gates them against reference, and promotes the fastest
+    # (persisted per backend/shape in autotune_cache.json so later runs
+    # and the serve fleet skip measurement); "reference" | "pallas" |
+    # "packed" pin an impl with zero measurement ("packed" is the flat
+    # one-hot-matmul layout, see EMBEDDING.md).  "" keeps the legacy
+    # interaction/use_pallas derivation, bit-identical to before the
+    # autotuner existed.  Routes training (the fused scan step) AND the
+    # compiled serving rungs; FFM (field_num > 0) always uses its
+    # closed-form op regardless.
+    interaction_impl: str = ""
+    # Persistent XLA compilation cache directory (jax's
+    # jax_compilation_cache_dir): restarts and replica spawns reuse
+    # compiled executables from disk instead of paying warmup compiles
+    # again.  "" = off.  platform.enable_compile_cache() is the one
+    # wiring point; platform.compile_cache_stats() counts hits/misses.
+    compile_cache_dir: str = ""
     # Sparse row updates (IndexedSlices-style): optimizer touches only the
     # rows in the batch. Falls back to dense when the optimizer/l2_mode
     # combination requires it (see train.sparse.supports_sparse).
@@ -452,6 +470,23 @@ class FmConfig:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.interaction not in ("", "pallas", "jnp", "flat"):
             raise ValueError(f"unknown interaction {self.interaction!r}")
+        if self.interaction_impl not in (
+            "", "auto", "reference", "pallas", "packed"
+        ):
+            raise ValueError(
+                f"unknown interaction_impl {self.interaction_impl!r} "
+                "(want auto | reference | pallas | packed, or '' for "
+                "the legacy interaction/use_pallas surface)"
+            )
+        if self.interaction_impl and self.interaction:
+            # Inert-knob discipline: interaction_impl supersedes the
+            # legacy knob, so a run setting both would silently ignore
+            # one of them — refuse at startup instead.
+            raise ValueError(
+                f"interaction_impl={self.interaction_impl!r} and the "
+                f"legacy interaction={self.interaction!r} are both set; "
+                "interaction_impl would silently win — drop one"
+            )
         if self.steps_per_dispatch < 1:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got {self.steps_per_dispatch}"
@@ -717,7 +752,18 @@ class FmConfig:
         return 1 + (k * self.field_num if self.field_num else k)
 
     @property
-    def interaction_impl(self) -> str:
+    def interaction_resolved(self) -> str:
+        """The ops.interaction dispatch name ("jnp" | "pallas" | "flat")
+        the step math should use — or "auto", which callers resolve
+        through ops.autotune.resolve() before building the step.
+        ``interaction_impl`` (the autotuner surface) supersedes the
+        legacy ``interaction``/``use_pallas`` derivation."""
+        if self.interaction_impl:  # validated in __post_init__
+            if self.interaction_impl == "auto":
+                return "auto"
+            return {
+                "reference": "jnp", "pallas": "pallas", "packed": "flat",
+            }[self.interaction_impl]
         if self.interaction:  # validated in __post_init__
             return self.interaction
         return "pallas" if self.use_pallas else "jnp"
@@ -809,6 +855,8 @@ _KEYMAP = {
     "compute_dtype": ("compute_dtype", str),
     "use_pallas": ("use_pallas", _parse_bool),
     "interaction": ("interaction", str),
+    "interaction_impl": ("interaction_impl", str),
+    "compile_cache_dir": ("compile_cache_dir", str),
     "sparse_update": ("sparse_update", _parse_bool),
     "sparse_apply": ("sparse_apply", str),
     "fast_ingest": ("fast_ingest", _parse_bool),

@@ -1706,9 +1706,15 @@ class DevicePrefetcher:
     def __init__(self, source, steps_per_dispatch: int, put_fn,
                  depth: int = 2, telemetry: Optional[obs.Telemetry] = None,
                  staging: bool = False,
-                 tracer: Optional[obs.Tracer] = None):
+                 tracer: Optional[obs.Tracer] = None,
+                 ship_fn=None):
         self._k = max(1, steps_per_dispatch)
         self._put_fn = put_fn
+        # Optional fused stack+H2D: ship_fn takes the raw batch group
+        # and returns the device super-batch in ONE transfer (parallel.
+        # mesh.FusedShipper), or None to decline — then the classic
+        # stack_batches + put_fn path below runs unchanged.
+        self._ship_fn = ship_fn
         # Transfer-stage instruments: stack vs H2D vs output-block time.
         # out_block large = the device is the bottleneck (healthy);
         # out_q_depth pinned low with the trainer starving = ingest-bound.
@@ -1721,6 +1727,7 @@ class DevicePrefetcher:
         self._t_out_block = tel.timer("prefetch.out_block")
         self._c_super = tel.counter("prefetch.super_batches")
         self._c_prestack = tel.counter("prefetch.prestack_hits")
+        self._c_fused = tel.counter("prefetch.fused_ships")
         # Trace correlation: this stage ASSIGNS the super-batch id (sb
         # = emission order, which the bounded FIFO output queue carries
         # unchanged to the consumer, so the train loop's own dispatch
@@ -1801,6 +1808,25 @@ class DevicePrefetcher:
         sb_id, batch0 = self._sb_id, self._batch_idx
         self._sb_id += 1
         self._batch_idx += len(group)
+        if self._ship_fn is not None:
+            # Fused stack+H2D: one staging copy, ONE device transfer,
+            # on-device carve.  Timed under the H2D instrument (it IS
+            # the transfer; there is no separate stack phase to time).
+            with self._t_put.time(), obs.trace_span("tffm:h2d"), \
+                    self._tracer.span(
+                        "prefetch.fused_ship",
+                        args={"sb": sb_id, "batch0": batch0,
+                              "n": len(group)},
+                        flow=("s", f"sb{sb_id}"),
+                    ):
+                dev = self._ship_fn(group)
+            if dev is not None:
+                self._c_fused.add(1)
+                self._c_super.add(1)
+                t0 = time.perf_counter()
+                ok = self._out.put((dev, len(group)))
+                self._t_out_block.observe(time.perf_counter() - t0)
+                return ok
         bufs = None
         with self._t_stack.time(), obs.trace_span("tffm:stack"), \
                 self._tracer.span(

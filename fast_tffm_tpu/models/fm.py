@@ -134,17 +134,32 @@ def scores_from_rows(
     factor_num: int,
     field_num: int = 0,
     compute_dtype=jnp.float32,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Score from pre-gathered rows — the shared tail of the fp32 and
     quantized forwards (plain FM and FFM both).  ``rows`` may arrive
     in any storage dtype (f32, bf16, or int8 already widened by
     ops.quant.dequant_gathered): both score paths upcast operands to
-    the compute dtype and accumulate in f32."""
+    the compute dtype and accumulate in f32.
+
+    ``impl`` routes the plain-FM interaction through an alternative
+    ops.interaction formulation ("pallas" | "flat") — the autotuner's
+    serving-side promotion hook (parity-gated against this reference
+    path by ops.autotune).  None/"jnp" is the reference math; FFM
+    always uses its closed-form path regardless.
+    """
     if field_num:
         assert fields is not None
         return ffm_scores_from_rows(
             w0, rows, vals, fields, factor_num, field_num, compute_dtype
         )
+    if impl not in (None, "", "jnp"):
+        from fast_tffm_tpu.ops import interaction as interaction_ops
+
+        scores, _ = interaction_ops._forward(
+            rows.astype(compute_dtype), vals.astype(compute_dtype), impl
+        )
+        return w0.astype(jnp.float32) + scores
     linear, s1, s2 = interaction_terms(rows, vals, compute_dtype)
     return scores_from_terms(w0.astype(compute_dtype), linear, s1, s2)
 
@@ -158,18 +173,21 @@ def fm_scores(
     factor_num: int,
     field_num: int = 0,
     compute_dtype=jnp.float32,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Oracle forward: gather + score. One `take` = one gather op for XLA.
 
     ``params.table`` may be stored bf16 (the compact serving format):
     the gather reads compact rows and :func:`scores_from_rows` widens
-    them in-register — XLA fuses the cast into the gather.
+    them in-register — XLA fuses the cast into the gather.  ``impl``
+    passes through to :func:`scores_from_rows` (the autotuner's
+    serving-side routing; None = reference).
     """
     rows = params.table[ids]  # [B, F, D]
     return scores_from_rows(
         params.w0, rows, vals, fields,
         factor_num=factor_num, field_num=field_num,
-        compute_dtype=compute_dtype,
+        compute_dtype=compute_dtype, impl=impl,
     )
 
 
@@ -185,12 +203,14 @@ def fm_scores_dequant(
     factor_num: int,
     field_num: int = 0,
     compute_dtype=jnp.float32,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Forward over an int8-quantized table: gather compact codes (a
     quarter of the fp32 row bytes) plus each row's scale chunk, widen
     in-register (ops.quant.dequant_gathered), score.  Identical math
     to :func:`fm_scores` on the dequantized table, pinned by
-    tests/test_quant.py."""
+    tests/test_quant.py.  ``impl`` passes through to
+    :func:`scores_from_rows` (autotuner routing; None = reference)."""
     from fast_tffm_tpu.ops import quant
 
     code_rows = codes[ids]  # [B, F, D] int8
@@ -199,7 +219,7 @@ def fm_scores_dequant(
     return scores_from_rows(
         w0, rows, vals, fields,
         factor_num=factor_num, field_num=field_num,
-        compute_dtype=compute_dtype,
+        compute_dtype=compute_dtype, impl=impl,
     )
 
 

@@ -15,6 +15,7 @@ from these shardings; there is no user-visible comms API (SURVEY.md §2
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Optional, Sequence
 
 import jax
@@ -162,6 +163,170 @@ def shard_batch(batch, mesh: Mesh):
         *(jax.device_put(getattr(batch, k), sh[k]) for k in core),
         sort_meta=meta,
     )
+
+
+_CORE_LEAVES = ("labels", "ids", "vals", "fields", "weights")
+_ALIGN = 128  # TPU/host DMA friendly; also keeps every view offset aligned
+
+
+def fused_h2d_enabled(mesh: Mesh) -> bool:
+    """Whether the fused stack+H2D ship path may run on this mesh.
+
+    Structural gates are unconditional: the fused buffer is shipped as
+    one replicated flat array and carved on-device, which only matches
+    the classic per-leaf sharding semantics on a single-device,
+    single-process mesh.  Within those gates the default is
+    TPU-only — on CPU ``device_put`` is zero-copy, so fusing buys
+    nothing and costs one extra unpack dispatch — overridable for
+    tests/bench via ``FAST_TFFM_FUSED_H2D`` (1 forces on, 0 forces
+    off).
+    """
+    if mesh.size != 1 or jax.process_count() > 1:
+        return False
+    import os
+
+    env = os.environ.get("FAST_TFFM_FUSED_H2D", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    from fast_tffm_tpu import platform
+
+    return platform.is_tpu_backend()
+
+
+class FusedShipper:
+    """Stack K parsed batches and ship them device-side in ONE transfer.
+
+    The classic transfer stage stacks K host batches into a [K, ...]
+    super-batch (one np.stack per leaf) and then issues one
+    ``device_put`` per leaf — 5-12 host-to-device DMAs per dispatch,
+    each paying launch latency.  This path instead copies every leaf of
+    every batch into a single contiguous uint8 staging buffer
+    (128-byte-aligned segments), ships it with ONE ``device_put``, and
+    carves the leaves back out on-device with a cached jitted unpack
+    (static slice -> bitcast -> reshape; bitwise-exact, no arithmetic).
+    The stack and the transfer fuse: the host-side np.stack writes land
+    directly in the DMA source buffer.
+
+    Calling the shipper returns the device Batch, or ``None`` to
+    decline (empty group) — the caller falls back to the classic
+    stack+put path.  ``sort_meta`` rides along iff every batch in the
+    group carries it, mirroring :func:`...pipeline.stack_batches`.
+
+    Staging buffers recycle through a small in-flight ring, blocking on
+    the oldest transfer before reuse — except on CPU, where
+    ``device_put`` is zero-copy (the device array ALIASES the host
+    buffer) so reuse would corrupt in-flight data; there every ship
+    allocates fresh.
+    """
+
+    def __init__(self, mesh: Mesh, depth: int = 2):
+        self._mesh = mesh
+        self._depth = max(1, depth)
+        self._unpack_cache: dict = {}  # spec -> jitted unpack
+        self._free: dict = {}  # total_bytes -> [np buffer, ...]
+        self._inflight: deque = deque()  # (dev_buf, total_bytes, host_buf)
+        self._reuse = jax.default_backend() != "cpu"
+        self.ships = 0  # fused dispatches completed (observability)
+
+    # -- spec -----------------------------------------------------------
+    def _spec(self, group):
+        """((name, dtype_str, per-batch shape), ...) for one group — the
+        unpack cache key.  Meta leaves append after core iff present on
+        every batch."""
+        b = group[0]
+        spec = [
+            (n, str(getattr(b, n).dtype), getattr(b, n).shape)
+            for n in _CORE_LEAVES
+        ]
+        if all(g.sort_meta is not None for g in group):
+            for i, x in enumerate(b.sort_meta):
+                spec.append((f"meta{i}", str(x.dtype), x.shape))
+        return len(group), tuple(spec)
+
+    @staticmethod
+    def _layout(k, spec):
+        """[(name, dtype, stacked shape, offset, nbytes), ...], total."""
+        off = 0
+        out = []
+        for name, dt, shape in spec:
+            dtype = np.dtype(dt)
+            nbytes = int(np.prod((k,) + shape, dtype=np.int64)) * dtype.itemsize
+            out.append((name, dtype, (k,) + shape, off, nbytes))
+            off += -(-nbytes // _ALIGN) * _ALIGN
+        return out, off
+
+    def _unpack_fn(self, key):
+        """Jitted buffer -> leaves carve for one (k, spec), cached."""
+        fn = self._unpack_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax.numpy as jnp
+        from jax import lax
+
+        k, spec = key
+        layout, _ = self._layout(k, spec)
+
+        def unpack(buf):
+            outs = []
+            for _, dtype, shape, off, nbytes in layout:
+                seg = buf[off:off + nbytes]
+                jdt = jnp.dtype(dtype)
+                if jdt.itemsize > 1:
+                    seg = seg.reshape(-1, jdt.itemsize)
+                seg = lax.bitcast_convert_type(seg, jdt)
+                outs.append(seg.reshape(shape))
+            return tuple(outs)
+
+        fn = jax.jit(unpack)
+        self._unpack_cache[key] = fn
+        return fn
+
+    def _acquire(self, total):
+        bufs = self._free.get(total)
+        if bufs:
+            return bufs.pop()
+        return np.empty(total, dtype=np.uint8)
+
+    def _retire(self, dev_buf, total, host_buf):
+        if not self._reuse:
+            return  # CPU: dev_buf aliases host_buf; never recycle
+        self._inflight.append((dev_buf, total, host_buf))
+        while len(self._inflight) > self._depth:
+            d, t, h = self._inflight.popleft()
+            jax.block_until_ready(d)
+            self._free.setdefault(t, []).append(h)
+
+    def __call__(self, group):
+        if not group:
+            return None
+        from fast_tffm_tpu.data import libsvm
+
+        key = self._spec(group)
+        k, spec = key
+        layout, total = self._layout(k, spec)
+        buf = self._acquire(total)
+        n_core = len(_CORE_LEAVES)
+        has_meta = len(spec) > n_core
+        for i, (name, dtype, shape, off, nbytes) in enumerate(layout):
+            view = buf[off:off + nbytes].view(dtype).reshape(shape)
+            if i < n_core:
+                cols = [getattr(b, name) for b in group]
+            else:
+                cols = [b.sort_meta[i - n_core] for b in group]
+            if k == 1:
+                np.copyto(view[0], cols[0])
+            else:
+                np.stack(cols, out=view)
+        dev_buf = jax.device_put(buf, self._mesh.devices.flat[0])
+        leaves = self._unpack_fn(key)(dev_buf)
+        self._retire(dev_buf, total, buf)
+        self.ships += 1
+        meta = None
+        if has_meta:
+            meta = type(group[0].sort_meta)(*leaves[n_core:])
+        return libsvm.Batch(*leaves[:n_core], sort_meta=meta)
 
 
 def shard_super_batch(batch, mesh: Mesh):

@@ -119,6 +119,98 @@ def ffm_compute_dtype(compute_dtype):
     return compute_dtype
 
 
+# ------------------------------------------------- persistent compile cache
+#
+# jax's on-disk compilation cache, behind the ``compile_cache_dir``
+# knob: a restart (or a replica spawn on the serve fleet) replays its
+# warmup compiles from disk instead of re-lowering through XLA — the
+# multi-second ladder warmup becomes a file read.  The monitoring
+# listener counts hit/miss events so the zero-fresh-lowers contract of
+# a warm spawn is checkable (tests + the serve log line), not assumed.
+
+_compile_cache_dir: str | None = None
+_compile_cache_events = {"hits": 0, "misses": 0}
+_compile_cache_listener_installed = False
+
+
+def enable_compile_cache(path: str) -> bool:
+    """Point jax's persistent compilation cache at ``path`` (created if
+    missing) and start counting cache hit/miss events.  Idempotent; a
+    falsy path is a no-op (returns False).  The min-size/min-time
+    floors are dropped so EVERY executable persists — this project's
+    rung/step compiles are small but warmup-critical."""
+    global _compile_cache_dir, _compile_cache_listener_installed
+    if not path:
+        return False
+    import jax
+
+    if _compile_cache_dir != path:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        # jax initializes its cache object AT MOST ONCE per process and
+        # latches the dir it saw then — a process that compiled anything
+        # before this call (tests, the bench probe) would silently keep
+        # running cache-less.  Reset so the next compile re-initializes
+        # against the new dir.
+        try:
+            from jax._src import compilation_cache as _jcc
+
+            _jcc.reset_cache()
+        except Exception as e:  # pragma: no cover - private-API drift
+            log.warning("compilation-cache reset unavailable (%s); "
+                        "mid-process enable may not take effect", e)
+        _compile_cache_dir = path
+        log.info("persistent compilation cache enabled at %s", path)
+    if not _compile_cache_listener_installed:
+        def _listener(event, **kw):  # noqa: ANN001 - jax callback API
+            if event == "/jax/compilation_cache/cache_hits":
+                _compile_cache_events["hits"] += 1
+            elif event == "/jax/compilation_cache/cache_misses":
+                _compile_cache_events["misses"] += 1
+
+        try:
+            jax.monitoring.register_event_listener(_listener)
+            _compile_cache_listener_installed = True
+        except Exception as e:  # pragma: no cover - jax API drift
+            log.warning(
+                "compile-cache event listener unavailable (%s); "
+                "hit/miss stats will read 0", e,
+            )
+    return True
+
+
+def disable_compile_cache() -> None:
+    """Turn the persistent cache back off (tests restore global state;
+    the event listener stays — it only counts)."""
+    global _compile_cache_dir
+    if _compile_cache_dir is None:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()  # drop the latched cache object (see enable)
+    except Exception:  # pragma: no cover - private-API drift
+        pass
+    _compile_cache_dir = None
+
+
+def compile_cache_stats() -> dict:
+    """{'dir', 'hits', 'misses'} — cumulative persistent-cache events
+    since the listener was installed.  A warm replica spawn with a
+    populated cache performs zero fresh lowers: its warmup adds hits,
+    never misses."""
+    return {
+        "dir": _compile_cache_dir or "",
+        "hits": _compile_cache_events["hits"],
+        "misses": _compile_cache_events["misses"],
+    }
+
+
 def pin_cpu(n_devices: int | None = None) -> None:
     """Force the CPU platform, optionally with ``n_devices`` virtual CPUs.
 

@@ -42,6 +42,7 @@ import logging
 import threading
 import time
 import warnings
+from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 from typing import Optional
 
@@ -53,6 +54,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from fast_tffm_tpu import obs
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.models import fm
+from fast_tffm_tpu.ops import autotune as autotune_lib
 from fast_tffm_tpu.ops import quant
 from fast_tffm_tpu.parallel import mesh as mesh_lib
 from fast_tffm_tpu.train import checkpoint
@@ -142,6 +144,27 @@ class _LadderScorer:
         self._arg_dtypes = (np.int32, np.float32, np.int32)
         self._n_args = 3 if cfg.field_num else 2
         self._feat = F
+        # Compile accounting may run from several warmup threads (the
+        # rung ladder compiles concurrently); a lock keeps the counter
+        # increments, steady accounting, and record writes coherent.
+        self._compile_lock = threading.Lock()
+        self.warmup_wall_s = 0.0  # wall clock of the last warmup()
+        self.warmup_compile_s = 0.0  # summed compile time inside warmup
+        # Kernel autotune (ops/autotune.py): with interaction_impl set,
+        # resolve the serve-path interaction impl at the max rung's
+        # shape (auto measures + parity-gates against reference; pins
+        # and the single-candidate CPU case skip measurement).  The
+        # resolved internal name routes the rung score math through
+        # ops.interaction._forward; None keeps the historical
+        # closed-form path bit-identical (reference IS that path).
+        self._impl = None
+        self.kernel_impl = "reference"
+        if cfg.interaction_impl:
+            d = autotune_lib.resolve(
+                cfg, context="serve", batch=self.max_rung, writer=writer,
+            )
+            self.kernel_impl = d.impl
+            self._impl = None if d.interaction == "jnp" else d.interaction
 
     # -- rung / pool helpers -------------------------------------------
 
@@ -197,10 +220,13 @@ class _LadderScorer:
     # -- compile accounting --------------------------------------------
 
     def _account_compile(self, wall: float, key, expected: bool) -> None:
-        self._t_compile.observe(wall)
-        self.compiles += 1
-        if self._warmed and not (expected and self._lazy_expected_ok):
-            self.steady_compiles += 1
+        with self._compile_lock:
+            self._t_compile.observe(wall)
+            self.compiles += 1
+            if not self._warmed:
+                self.warmup_compile_s += wall
+            if self._warmed and not (expected and self._lazy_expected_ok):
+                self.steady_compiles += 1
         if not expected:
             self._c_unexpected.add()
             log.warning(
@@ -225,12 +251,40 @@ class _LadderScorer:
     def warmup(self) -> int:
         """Precompile every ladder rung; returns the compile count.
         After this returns, a correctly-configured server never
-        compiles again (``steady_compiles`` stays 0)."""
+        compiles again (``steady_compiles`` stays 0).
+
+        Rungs compile CONCURRENTLY: each rung is an independent
+        ``.lower().compile()`` at a distinct cache key and XLA releases
+        the GIL while compiling, so a thread per rung overlaps what
+        used to be a serial multi-second ladder walk.  The saving is
+        recorded (``warmup_compile_s`` summed vs ``warmup_wall_s``
+        observed) — with a populated persistent compile cache both
+        collapse to near zero and the warm-spawn zero-fresh-lowers
+        contract is checkable.
+        """
+        t0 = time.perf_counter()
         with self._lock:
-            for b in self.ladder:
-                self._warm_rung(b)
+            if len(self.ladder) > 1 and not self._aot_broken:
+                with ThreadPoolExecutor(
+                    max_workers=min(len(self.ladder), 8),
+                    thread_name_prefix="tffm-warmup",
+                ) as ex:
+                    # list() re-raises the first rung failure, matching
+                    # the serial path's error contract.
+                    list(ex.map(self._warm_rung, self.ladder))
+            else:
+                for b in self.ladder:
+                    self._warm_rung(b)
+        self.warmup_wall_s = time.perf_counter() - t0
         self._warmed = True
         self.steady_compiles = 0
+        if self.compiles and self.warmup_compile_s > self.warmup_wall_s:
+            log.info(
+                "concurrent ladder warmup: %.2fs of compiles in %.2fs "
+                "wall (%.2fs saved)",
+                self.warmup_compile_s, self.warmup_wall_s,
+                self.warmup_compile_s - self.warmup_wall_s,
+            )
         return self.compiles
 
     # -- scoring -------------------------------------------------------
@@ -360,6 +414,7 @@ class FixedShapeScorer(_LadderScorer):
         self._g_table_bytes = self._tel.gauge("serve.table_bytes")
         self._g_quant_err = self._tel.gauge("serve.quant_error_max")
         self._params = self._place(params)
+        impl = self._impl  # autotune-resolved interaction routing
         if self.table_dtype == "int8":
             chunk = self._chunk
             if cfg.field_num:
@@ -376,6 +431,7 @@ class FixedShapeScorer(_LadderScorer):
                         params.w0, params.codes, params.scales, chunk,
                         ids, vals, None,
                         factor_num=cfg.factor_num, field_num=0,
+                        impl=impl,
                     ))
             param_sh_tree = quant.QuantParams(
                 w0=self._param_sh.w0,
@@ -400,6 +456,7 @@ class FixedShapeScorer(_LadderScorer):
                     return self._finish(fm.fm_scores(
                         params, ids, vals, None,
                         factor_num=cfg.factor_num, field_num=0,
+                        impl=impl,
                     ))
             param_sh_tree = self._param_sh
         self._jit = jax.jit(

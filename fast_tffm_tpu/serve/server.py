@@ -51,7 +51,7 @@ from typing import Optional
 
 import numpy as np
 
-from fast_tffm_tpu import obs
+from fast_tffm_tpu import obs, platform
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.obs.status import (
     ObsHTTPServer, PooledHTTPServer, QuietHandler,
@@ -560,6 +560,18 @@ def _serve_block(snap: dict, scorer, batcher, wall: float) -> dict:
         "http_threads": int(getattr(
             getattr(scorer, "cfg", None), "serve_http_threads", 0
         ) or 0),
+        # Which interaction impl the compiled rungs run (autotune
+        # surface; a string — /metrics skips it, the JSONL block keeps
+        # it) plus the concurrent-warmup accounting: summed compile
+        # seconds vs observed wall, whose gap is the wall the
+        # concurrent ladder warmup saved.
+        "kernel_impl": getattr(scorer, "kernel_impl", "reference"),
+        "warmup_wall_s": round(
+            float(getattr(scorer, "warmup_wall_s", 0.0)), 4
+        ),
+        "warmup_compile_s": round(
+            float(getattr(scorer, "warmup_compile_s", 0.0)), 4
+        ),
     }
     # Quantized-table accounting, emitted only when the scorer owns
     # the gauges (FixedShapeScorer): the device-resident table's real
@@ -603,6 +615,12 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
     ``port`` overrides ``cfg.serve_port`` (tests pass 0 for an
     OS-assigned port; the bound port is ``handle.port``).
     """
+    # Persistent XLA compilation cache (compile_cache_dir knob),
+    # enabled before the scorer's warmup compiles: a replica spawned
+    # against a populated cache replays its whole ladder from disk —
+    # zero fresh lowers (platform.compile_cache_stats counts both ways).
+    if cfg.compile_cache_dir:
+        platform.enable_compile_cache(cfg.compile_cache_dir)
     writer = (
         obs.JsonlWriter(cfg.metrics_file) if cfg.metrics_file else None
     )
@@ -666,6 +684,14 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
         "precompiled — steady-state serving performs zero compiles",
         scorer.step, list(scorer.ladder), n_compiles,
     )
+    if cfg.compile_cache_dir:
+        stats = platform.compile_cache_stats()
+        log.info(
+            "compile cache %s: %d hit(s), %d miss(es) during warmup%s",
+            stats["dir"], stats["hits"], stats["misses"],
+            " — warm spawn, zero fresh lowers"
+            if stats["hits"] and not stats["misses"] else "",
+        )
     batcher = ServeBatcher(
         scorer, max_batch_wait_ms=cfg.max_batch_wait_ms,
         queue_size=cfg.queue_size, telemetry=telemetry, tracer=tracer,
@@ -723,6 +749,9 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             "telemetry": cfg.telemetry,
             "heartbeat_secs": cfg.heartbeat_secs,
             "quality": cfg.quality,
+            "kernel_impl": getattr(scorer, "kernel_impl", "reference"),
+            "interaction_impl": cfg.interaction_impl,
+            "compile_cache_dir": cfg.compile_cache_dir,
         })
     # Alert watchdog riding the serve heartbeat (same contract as the
     # trainer's: FmConfig guarantees heartbeat_secs > 0 when rules are

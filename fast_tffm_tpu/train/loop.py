@@ -35,8 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from fast_tffm_tpu import obs
+from fast_tffm_tpu import obs, platform
 from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.ops import autotune as autotune_lib
 from fast_tffm_tpu.data.libsvm import Batch
 from fast_tffm_tpu.data.pipeline import (
     BatchPipeline, DevicePrefetcher, EpochEnd,
@@ -391,6 +392,11 @@ class Trainer:
 
     def __init__(self, cfg: FmConfig, mesh=None):
         self.cfg = cfg
+        # Persistent XLA compilation cache (compile_cache_dir knob):
+        # enabled before ANY jit below so restarts replay this run's
+        # step/eval compiles from disk instead of re-lowering.
+        if cfg.compile_cache_dir:
+            platform.enable_compile_cache(cfg.compile_cache_dir)
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg)
         # Run-wide telemetry registry, shared by the ingest pipeline, the
         # transfer thread, and the dispatch loop.  Disabled -> every
@@ -486,9 +492,29 @@ class Trainer:
         )
 
         state_sh = jax.tree.map(lambda x: x.sharding, self.state)
+        # Kernel autotune (ops/autotune.py): interaction_impl=auto
+        # benchmarks the candidate interaction paths at THIS run's
+        # (batch, dim) shapes and promotes the fastest that passes the
+        # element-wise parity gate; pins and the legacy knobs resolve
+        # with zero measurement.  The decision rewrites the device
+        # config's legacy `interaction` field so every step builder
+        # keeps its single dispatch point (cfg.interaction_resolved).
+        self._autotune: Optional[autotune_lib.Decision] = None
+        if self._dcfg.interaction_resolved == "auto":
+            self._autotune = autotune_lib.resolve(self._dcfg, context="train")
+            self._dcfg = dataclasses.replace(
+                self._dcfg, interaction_impl="",
+                interaction=self._autotune.interaction,
+            )
+        # The user-facing name of the impl this run trains with —
+        # surfaced in the run header and bench JSON as `kernel_impl`.
+        self.kernel_impl = autotune_lib.USER.get(
+            self._dcfg.interaction_resolved, self._dcfg.interaction_resolved
+        )
         # All device-side step math is built from _dcfg: identical to cfg
         # except that, with tiering on, vocabulary_size is the hot-table
-        # size (the step's math never reads the vocab beyond table shape).
+        # size (the step's math never reads the vocab beyond table shape)
+        # — and, after an autotune resolution, the measured interaction.
         dcfg = self._dcfg
         step_fn = (
             make_sparse_train_step(dcfg, self.mesh)
@@ -505,7 +531,7 @@ class Trainer:
             "interpret=%s backend=%s mesh=%s",
             self.sparse,
             sparse_lib.apply_mode(dcfg, self.mesh) if self.sparse else "dense",
-            cfg.interaction_impl, use_interpret(), jax.default_backend(),
+            dcfg.interaction_resolved, use_interpret(), jax.default_backend(),
             dict(self.mesh.shape),
         )
         self._train_step = jax.jit(
@@ -1394,7 +1420,12 @@ class Trainer:
                 "resume_step": self._restored_step,
                 "resume_epoch": resume_epoch,
                 "resume_skip": resume_skip,
+                "kernel_impl": self.kernel_impl,
+                "interaction_impl": cfg.interaction_impl,
+                "compile_cache_dir": cfg.compile_cache_dir,
             })
+            if self._autotune is not None:
+                autotune_lib.write_record(metrics_out, self._autotune)
         # Seed the step-rate interval from the CURRENT metric state, not
         # 0: a warm-started Trainer (or a second train() on the same
         # instance) carries pre-resume examples in metrics.count, and the
@@ -1565,6 +1596,18 @@ class Trainer:
         # batch trains exactly once and ``batches_done`` only ever
         # advances by whole dispatches — a saved position always lands
         # on a super-batch boundary.
+        # Fused stack+H2D (mesh.FusedShipper): where the mesh/backend
+        # allow it, the transfer thread copies the K batches into ONE
+        # contiguous staging buffer and ships it with a single
+        # device_put instead of stack-then-put-per-leaf.  Tiering needs
+        # the host-side id remap between stack and put, so it keeps the
+        # classic path.
+        ship_fn = None
+        if self.tiered is None and mesh_lib.fused_h2d_enabled(self.mesh):
+            ship_fn = mesh_lib.FusedShipper(
+                self.mesh, depth=cfg.prefetch_super_batches
+            )
+            log.info("fused stack+H2D transfer path enabled")
         prefetcher = DevicePrefetcher(
             pipeline, k, self._put_super,
             depth=cfg.prefetch_super_batches,
@@ -1574,6 +1617,7 @@ class Trainer:
             # super-batch of host memory per dispatch.
             staging=True,
             tracer=self.tracer,
+            ship_fn=ship_fn,
         )
         cache_logged = not cfg.cache_epochs
 

@@ -157,7 +157,7 @@ def _rows_loss_fn(
             scores = w0 + interaction.fm_interaction_sharded(
                 rows.astype(compute_dtype),
                 batch.vals.astype(compute_dtype),
-                cfg.interaction_impl, mesh, data_axis,
+                cfg.interaction_resolved, mesh, data_axis,
             )
         per_ex = fm.example_losses(scores, batch.labels, cfg.loss_type)
         wsum = jnp.maximum(jnp.sum(batch.weights), 1e-12)

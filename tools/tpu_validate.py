@@ -337,7 +337,7 @@ def main() -> int:
         ms = dt * 1e3 / steps
         emit(json.dumps({
             "step": (
-                f"sparse_apply={mode} interaction={cfg.interaction_impl} "
+                f"sparse_apply={mode} interaction={cfg.interaction_resolved} "
                 f"compute_dtype={dtype}"
                 + (f" field_num={field_num}" if field_num else "")
                 + ("" if host_sort else " host_sort=off")

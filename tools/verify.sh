@@ -29,6 +29,14 @@ if [ "${1:-}" = "--audit" ]; then
 fi
 
 echo
+echo "== kernel-autotune invariants (tools/autotune.py --check) =="
+# The autotuner's own contract on this backend: CPU `auto` resolves to
+# reference with ZERO measurements (the near-zero-overhead budget), a
+# forced multi-candidate measurement parity-gates and caches its
+# winner, and any cache file on disk is self-consistent.
+JAX_PLATFORMS=cpu python tools/autotune.py --check || exit 1
+
+echo
 echo "== live observability + serving smoke (tools/obs_smoke.py) =="
 # A real CLI run with --status_port: /metrics must serve parseable
 # Prometheus text (incl. the resource block + tffm_build_info) and
