@@ -366,7 +366,8 @@ def _bench_parse_only(files, cfg) -> float:
 def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
                k: int = 1, telemetry_enabled: bool = True,
                tracer=None, status: bool = False,
-               resource: bool = False, quality: bool = False) -> tuple:
+               resource: bool = False, quality: bool = False,
+               fleet: bool = False) -> tuple:
     """Examples/sec through BatchPipeline + DevicePrefetcher — the
     train() hot path: parse threads, the stacking/H2D transfer thread,
     and the K-step fused dispatch all overlapped.  ``warmup`` counts
@@ -431,6 +432,7 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     scrape_stop = threading.Event()
     scraper = None
     res_sampler = None
+    fleet_plane = None
 
     def _start_resource():
         nonlocal res_sampler
@@ -467,6 +469,48 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
                 "time": time.time(),
                 "stages": tel.snapshot(),
             },
+            telemetry=tel,
+        )
+
+        def _scrape():
+            url = f"http://127.0.0.1:{status_server.port}/metrics"
+            while not scrape_stop.wait(0.2):
+                try:
+                    urllib.request.urlopen(url, timeout=2).read()
+                except Exception:  # noqa: BLE001 - probe must not die
+                    pass
+
+        scraper = threading.Thread(target=_scrape, daemon=True)
+        scraper.start()
+
+    def _start_fleet():
+        # The training-fleet plane at production shape (ISSUE 18):
+        # the live /status endpoint with the per-rank metrics_extra
+        # hook, a TrainFleet scraping it on the heartbeat cadence
+        # (0.2 s, the smoke/aggressive setting), and an external
+        # /metrics scraper on top — prices scrape + merge +
+        # labeled-series rendering together.
+        nonlocal status_server, scraper, fleet_plane
+        import urllib.request
+
+        t0 = time.time()
+        status_server = obs.StatusServer(
+            0,
+            lambda: {
+                "record": "status",
+                "time": time.time(),
+                "rank": 0,
+                "step": 0,
+                "elapsed": round(time.time() - t0, 3),
+                "stages": tel.snapshot(),
+            },
+            telemetry=tel,
+            metrics_extra=lambda: (
+                fleet_plane.metrics_lines() if fleet_plane else ""
+            ),
+        )
+        fleet_plane = obs.TrainFleet(
+            [f"127.0.0.1:{status_server.port}"], interval_s=0.2,
             telemetry=tel,
         )
 
@@ -529,6 +573,8 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
     try:
         if status:
             _start_status()
+        if fleet:
+            _start_fleet()
         if resource:
             _start_resource()
         warmed = 0
@@ -601,6 +647,8 @@ def _bench_e2e(trainer, cfg, files, warmup: int, epochs: int,
             scraper.join()
         if res_sampler is not None:
             res_sampler.join()
+        if fleet_plane is not None:
+            fleet_plane.close()
         if status_server is not None:
             status_server.close()
         prefetcher.close()
@@ -1661,6 +1709,7 @@ def main() -> int:
     e2e_status_on = 0.0
     e2e_resource_on = 0.0
     e2e_quality_on = 0.0
+    e2e_fleet_on = 0.0
     bench_compile_s = 0.0
     autotune_rate_auto, autotune_rate_ref = 0.0, 0.0
     autotune_kernel_impl, autotune_times = "", {}
@@ -1880,6 +1929,23 @@ def main() -> int:
                     except Exception as e:  # noqa: BLE001 - report only
                         ladder_errors.append(
                             f"quality probe: {type(e).__name__}: {e}"
+                        )
+                    # Training-fleet scrape overhead probe (ISSUE 18,
+                    # same paired shape): the identical K=8 e2e with
+                    # the live endpoint up, a TrainFleet scraping its
+                    # /status every 200 ms, AND /metrics (with the
+                    # per-rank labeled-series hook) scraped on top.
+                    # fleet_scrape_overhead = off/on rate ratio;
+                    # budget <= 1.05 like every other obs layer.
+                    try:
+                        e2e_fleet_on, _, _, _, _ = _bench_e2e(
+                            trainer, cfg, files, warmup=4,
+                            epochs=epochs, k=K, fleet=True,
+                        )
+                    except Exception as e:  # noqa: BLE001 - report only
+                        ladder_errors.append(
+                            f"fleet scrape probe: "
+                            f"{type(e).__name__}: {e}"
                         )
                     # Kernel-autotune overhead probe (ISSUE 17),
                     # PAIRED: the identical K=8 step-scan through a
@@ -2165,6 +2231,15 @@ def main() -> int:
         "status_endpoint_overhead": round(
             e2e_rate / e2e_status_on, 4
         ) if e2e_status_on > 0 and e2e_rate > 0 else 0.0,
+        # Training-fleet scrape overhead: the same K=8 e2e with the
+        # endpoint up, a TrainFleet scraping /status every 200 ms, and
+        # /metrics (per-rank labeled series included) scraped on top.
+        # off/on rate ratio, budget <= 1.05 — scrape + merge + render
+        # all run off the training thread, so ~1.0 = free.
+        "e2e_fleet_on_examples_per_sec": round(e2e_fleet_on, 1),
+        "fleet_scrape_overhead": round(
+            e2e_rate / e2e_fleet_on, 4
+        ) if e2e_fleet_on > 0 and e2e_rate > 0 else 0.0,
         # Resource-plane overhead: the same K=8 e2e with RSS/ledger/
         # sentinel sampling at 200 ms.  off/on rate ratio, budget
         # <= 1.05 — the sampler only reads /proc and lock-guarded

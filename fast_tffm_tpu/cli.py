@@ -167,6 +167,17 @@ def build_argparser() -> argparse.ArgumentParser:
              "without overwriting the checkpoint",
     )
     p.add_argument(
+        "--train_fleet_scrape", default=None, metavar="H:P,H:P,...",
+        help="live training-fleet plane: each rank's status endpoint "
+             "(host:port, rank order); rank 0 scrapes every rank's "
+             "/status on the heartbeat cadence into a `fleet` record "
+             "block (straggler_ratio, rank_step_skew, exchange_frac, "
+             "scrape staleness — all alertable) and per-rank "
+             "tffm_train_rank_* series on its /metrics (requires "
+             "--heartbeat_secs; empty = off, bitwise-identical "
+             "training)",
+    )
+    p.add_argument(
         "--no_quality", action="store_true",
         help="disable the model-quality & data-drift plane: no "
              "distribution sketches on the parse/serve paths, no "
@@ -365,6 +376,7 @@ def main(argv=None) -> int:
                     "trace_file", "nan_policy", "table_tiering", "hot_rows",
                     "cold_dtype", "serve_table_dtype", "quant_chunk",
                     "status_port", "status_host", "alert_rules",
+                    "train_fleet_scrape",
                     "trace_rotate_events", "serve_port", "serve_host",
                     "serve_batch_sizes", "max_batch_wait_ms",
                     "serve_poll_secs", "serve_replicas",

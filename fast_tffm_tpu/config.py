@@ -318,6 +318,22 @@ class FmConfig:
     # this-many examples).  Smaller = faster drift detection, noisier
     # statistics.
     quality_window: int = 65536
+    # Live training-fleet aggregation plane (obs/fleet.py): comma-
+    # separated host:port status endpoints, one per rank in rank order
+    # (each rank's own --status_port surface).  When set, rank 0
+    # scrapes every target's /status on the heartbeat cadence, merges
+    # the per-rank records into a `fleet` block on its heartbeat/
+    # status/final records (summed examples, weighted wait fractions,
+    # MAX-merged tails, scrape staleness) with live straggler
+    # attribution (straggler_ratio, slowest_rank + share,
+    # rank_step_skew, exchange_frac — all alertable), appends per-rank
+    # tffm_train_rank_* labeled series to its /metrics, and the
+    # multi-device dispatch loop times the cross-rank collective
+    # barrier (train.exchange, one-dispatch-delayed — no pipeline
+    # bubble).  Requires heartbeat_secs > 0 (the scrape cadence).
+    # "" = off: no scrape thread, no probe, bitwise-identical
+    # training — same contract as every other obs knob.
+    train_fleet_scrape: str = ""
     # Windowed trace rotation: when the tracer's buffer reaches this
     # many events it dumps and resets, producing trace.0.json,
     # trace.1.json, ... (merge with tools/report.py --trace) — removes
@@ -530,6 +546,28 @@ class FmConfig:
                 "trace_rotate_events requires trace_file (it is a "
                 "storage policy of the trace output)"
             )
+        if self.train_fleet_scrape:
+            # The aggregator scrapes on the heartbeat cadence and its
+            # `fleet` block rides the heartbeat-shaped records; with
+            # no heartbeat the plane would be configured but silently
+            # dead — same inertness rule as alert_rules below.
+            if self.heartbeat_secs <= 0:
+                raise ValueError(
+                    "train_fleet_scrape requires heartbeat_secs > 0 "
+                    "(rank 0 scrapes the fleet on the heartbeat "
+                    "cadence; without one the plane would never run)"
+                )
+            for target in self.train_fleet_scrape.split(","):
+                target = target.strip()
+                if not target:
+                    continue
+                host, sep, port = target.rpartition(":")
+                if not sep or not host or not port.isdigit() \
+                        or not 0 < int(port) < 65536:
+                    raise ValueError(
+                        "train_fleet_scrape targets must be host:port "
+                        f"pairs, got {target!r}"
+                    )
         if self.alert_rules:
             # Parse at construction so a typo'd rule fails the run at
             # startup, not silently at the first heartbeat.  The obs
@@ -587,6 +625,23 @@ class FmConfig:
                         "would carry no quality block / skew keys and "
                         "these rules could never fire; enable quality "
                         "or drop the rules"
+                    )
+            # And for the training-fleet plane: straggler_ratio /
+            # rank_step_skew / exchange_frac (and any explicit
+            # fleet.* path) only exist in the `fleet` block rank 0
+            # builds when train_fleet_scrape names the targets.
+            if not self.train_fleet_scrape:
+                inert = [
+                    r.signal for r in rules
+                    if resolved_signal(r.signal).startswith("fleet.")
+                ]
+                if inert:
+                    raise ValueError(
+                        f"alert_rules watch training-fleet signals "
+                        f"{inert} but train_fleet_scrape is unset — "
+                        "no record would carry a fleet block and "
+                        "these rules could never fire; set "
+                        "train_fleet_scrape or drop the rules"
                     )
         if not 0 <= self.serve_port < 65536:
             raise ValueError(
@@ -848,6 +903,7 @@ _KEYMAP = {
     "quality": ("quality", _parse_bool),
     "quality_window": ("quality_window", int),
     "trace_rotate_events": ("trace_rotate_events", int),
+    "train_fleet_scrape": ("train_fleet_scrape", str),
     "max_features": ("max_features", int),
     "mesh_data": ("mesh_data", int),
     "mesh_model": ("mesh_model", int),

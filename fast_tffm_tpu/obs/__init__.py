@@ -22,7 +22,12 @@ from fast_tffm_tpu.obs.alerts import (
     AlertEngine, AlertHaltError, AlertRule, halt_error,
     parse_rules, run_until_halt,
 )
-from fast_tffm_tpu.obs.heartbeat import Heartbeat, JsonlWriter
+from fast_tffm_tpu.obs.fleet import (
+    MergeSpec, TrainFleet, labeled_lines, merge_blocks,
+)
+from fast_tffm_tpu.obs.heartbeat import (
+    Heartbeat, JsonlWriter, rank_suffix_path,
+)
 from fast_tffm_tpu.obs.quality import (
     QualityMonitor, ServeSkewMonitor, StreamSketch,
 )
@@ -36,7 +41,9 @@ from fast_tffm_tpu.obs.trace import NULL_TRACER, Tracer
 
 __all__ = [
     "Counter", "Gauge", "Timing", "DepthHist", "Telemetry", "NULL",
-    "trace_span", "Heartbeat", "JsonlWriter", "Tracer", "NULL_TRACER",
+    "trace_span", "Heartbeat", "JsonlWriter", "rank_suffix_path",
+    "Tracer", "NULL_TRACER",
+    "MergeSpec", "TrainFleet", "labeled_lines", "merge_blocks",
     "StatusServer", "render_prometheus",
     "AlertEngine", "AlertHaltError", "AlertRule", "halt_error",
     "parse_rules", "run_until_halt",

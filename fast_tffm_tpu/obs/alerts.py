@@ -122,6 +122,23 @@ _ALIASES = {
     "evictions": "serve.evictions",
     "respawns": "serve.respawns",
     "fleet_scrape_age_max_s": "serve.fleet_scrape_age_max_s",
+    # Training-fleet plane (the `fleet` block rank 0's records carry
+    # when train_fleet_scrape is set — obs/fleet.py): live straggler
+    # attribution, step desync, and the cross-rank collective's share
+    # of the wall.
+    "straggler_ratio": "fleet.straggler_ratio",
+    "rank_step_skew": "fleet.rank_step_skew",
+    "exchange_frac": "fleet.exchange_frac",
+}
+
+# Signals that exist on MORE than one plane under different spellings:
+# the serve block says `fleet_scrape_age_max_s`, the train fleet block
+# says `scrape_age_max_s` (its block already names the plane).  The
+# primary alias keeps the historical serve path; when that resolves to
+# nothing on a record, these alternates are tried in order — so one
+# staleness rule works against either plane's records.
+_FALLBACKS = {
+    "fleet_scrape_age_max_s": ("fleet.scrape_age_max_s",),
 }
 
 
@@ -310,7 +327,13 @@ class AlertEngine:
             return _empty_frac(rec, "ingest.out_q_depth")
         if name == "prefetch_out_empty_frac":
             return _empty_frac(rec, "prefetch.out_q_depth")
-        return _resolve(rec, _ALIASES.get(name, name))
+        value = _resolve(rec, _ALIASES.get(name, name))
+        if value is None:
+            for alt in _FALLBACKS.get(name, ()):
+                value = _resolve(rec, alt)
+                if value is not None:
+                    break
+        return value
 
     def observe(self, record: dict) -> List[dict]:
         now = self._clock()

@@ -24,6 +24,18 @@ from typing import Callable, Optional
 log = logging.getLogger(__name__)
 
 
+def rank_suffix_path(path: str, rank: int) -> str:
+    """The one spelling of per-rank JSONL/trace output paths: rank 0
+    owns the configured path, ranks > 0 suffix ``.rank{N}``.  Every
+    multi-host writer (metrics stream, trace file) routes through this
+    so two ranks can never append into one stream and double-count a
+    merged report (``tools/report.py`` groups the family back
+    together by the suffix + each record's ``rank`` tag)."""
+    if rank <= 0 or not path:
+        return path
+    return f"{path}.rank{rank}"
+
+
 class JsonlWriter:
     """Lock-serialized line-per-record JSON writer (append mode)."""
 

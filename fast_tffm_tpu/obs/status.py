@@ -184,7 +184,8 @@ def render_prometheus(record: dict) -> str:
             lines.append(f"# TYPE {base}_bucket gauge")
             for band, n in buckets.items():
                 lines.append(f'{base}_bucket{{band="{band}"}} {n}')
-    for block in ("health", "tiered", "resource", "serve", "quality"):
+    for block in ("health", "tiered", "resource", "serve", "quality",
+                  "fleet"):
         for key, val in sorted((record.get(block) or {}).items()):
             emit(f"tffm_{block}_{_prom_name(key)}", val)
     info = record.get("build_info")
@@ -569,15 +570,22 @@ class StatusServer:
     on-demand capture callable ``profile(secs) -> output_dir`` behind
     ``/profile?secs=N`` — the server only guards it (one capture at a
     time; a concurrent request gets 409) and clamps ``secs`` to
-    [0.1, 120]; without it the route 404s.  ``close()`` shuts the
-    server down and joins its thread; idempotent.
+    [0.1, 120]; without it the route 404s.  ``metrics_extra``
+    (optional) returns extra pre-rendered Prometheus text appended to
+    every ``/metrics`` response — the hook the training-fleet plane
+    uses for its per-rank ``tffm_train_rank_*`` labeled series
+    (obs/fleet.py); its failures degrade to the base exposition, never
+    a dead scrape.  ``close()`` shuts the server down and joins its
+    thread; idempotent.
     """
 
     def __init__(self, port: int, build: Callable[[], Optional[dict]],
                  telemetry=None, host: str = "127.0.0.1",
-                 profile: Optional[Callable[[float], str]] = None):
+                 profile: Optional[Callable[[float], str]] = None,
+                 metrics_extra: Optional[Callable[[], str]] = None):
         self._build = build
         self._profile = profile
+        self._metrics_extra = metrics_extra
         self._profile_lock = threading.Lock()
         self._requests = (
             telemetry.counter("status.requests")
@@ -590,12 +598,42 @@ class StatusServer:
                 if server._requests is not None:
                     server._requests.add()
                 path, _, query = self.path.partition("?")
+                if (
+                    path == "/metrics"
+                    and server._metrics_extra is not None
+                ):
+                    self._do_metrics_extra()
+                    return
                 if self._get_observability(path, server._build):
                     return
                 if path == "/profile":
                     self._do_profile(query)
                     return
                 self._send(404, b"not found\n", "text/plain")
+
+            def _do_metrics_extra(self) -> None:
+                """/metrics with the owner's extra labeled series
+                appended (fleet per-rank series).  The base record
+                keeps the shared 500-on-builder-failure contract; a
+                failing extra hook degrades to the base exposition —
+                per-rank decoration must never kill the scrape."""
+                try:
+                    record = server._build() or {}
+                    body = render_prometheus(record)
+                except Exception as e:  # noqa: BLE001 - report, don't die
+                    self._send(
+                        500, f"builder failed: {e}\n".encode(),
+                        "text/plain",
+                    )
+                    return
+                try:
+                    body += server._metrics_extra() or ""
+                except Exception as e:  # noqa: BLE001
+                    log.warning("metrics_extra hook failed: %s", e)
+                self._send(
+                    200, body.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
 
             def _do_profile(self, query: str) -> None:
                 """On-demand profiler window.  Blocks THIS handler

@@ -1200,82 +1200,42 @@ class ServeRouter:
         ("skew_psi_max", "tffm_serve_replica_skew_psi_max", "gauge"),
     )
 
+    # The serving fleet's merge over scraped replica serve blocks —
+    # sums for the monotonic counters and rates, a request-weighted
+    # mean for p50, MAX for the tails (a merged p99 cannot be computed
+    # from per-replica percentiles; the max is the honest conservative
+    # bound), a plain mean for batch fill, and the training→serving
+    # skew PSIs MAX-merged under their SAME key names (a per-replica
+    # PSI is already a distribution distance; the fleet's worst one is
+    # the aggregate — a mean would dilute a single skewed replica
+    # N-fold) with skew_examples summed (mass, not distance).  The
+    # semantics live in obs.merge_blocks, shared with the training
+    # fleet plane (obs/fleet.py) so the two cannot drift.
+    _FLEET_SPEC = obs.MergeSpec(
+        sums=("requests", "examples", "batches", "qps",
+              "steady_compiles", "recompiles_unexpected"),
+        weighted=("p50_ms",),
+        weight_key="requests",
+        tails=("p95_ms", "p99_ms", "max_ms"),
+        means=("batch_fill",),
+        max_same=("skew_psi_values", "skew_psi_lengths",
+                  "skew_psi_ids", "skew_psi_scores", "skew_psi_max"),
+        sum_same_int=("skew_examples",),
+        prefix="fleet_",
+        count_key="replicas_scraped",
+        age_key="fleet_scrape_age_max_s",
+    )
+
     def _fleet_aggregates(self, per: list, scrapes: dict,
                           now: float) -> dict:
         """Fleet-level aggregates over the latest per-replica /status
-        scrapes: sums for the monotonic counters and rates, a
-        request-weighted mean for p50, MAX for the tails (a merged
-        p99 cannot be computed from per-replica percentiles — the max
-        is the honest conservative bound), and the scrape staleness
-        the alert plane watches."""
-        blocks = [
-            (scrapes[p["index"]], p["index"])
-            for p in per if p["index"] in scrapes
-        ]
-        if not blocks:
-            return {"replicas_scraped": 0}
-        out = {"replicas_scraped": len(blocks)}
-        for key in ("requests", "examples", "batches", "qps",
-                    "steady_compiles", "recompiles_unexpected"):
-            vals = [b.get(key) for (_, b), _i in blocks]
-            vals = [v for v in vals if isinstance(v, (int, float))]
-            if vals:
-                out[f"fleet_{key}"] = round(sum(vals), 2)
-        weights = [
-            max(1, int((b.get("requests") or 0)))
-            for (_, b), _i in blocks
-        ]
-        p50s = [
-            (b.get("p50_ms"), w)
-            for ((_, b), _i), w in zip(blocks, weights)
-            if isinstance(b.get("p50_ms"), (int, float))
-        ]
-        if p50s:
-            out["fleet_p50_ms"] = round(
-                sum(v * w for v, w in p50s) / sum(w for _, w in p50s),
-                4,
-            )
-        for key in ("p95_ms", "p99_ms", "max_ms"):
-            vals = [
-                b.get(key) for (_, b), _i in blocks
-                if isinstance(b.get(key), (int, float))
-            ]
-            if vals:
-                out[f"fleet_{key}"] = round(max(vals), 4)
-        fills = [
-            b.get("batch_fill") for (_, b), _i in blocks
-            if isinstance(b.get("batch_fill"), (int, float))
-        ]
-        if fills:
-            out["fleet_batch_fill"] = round(
-                sum(fills) / len(fills), 6
-            )
-        # Training→serving skew (the replicas' skew_* keys,
-        # obs/quality.py): MAX-merged under the SAME key names, so one
-        # router scrape answers "is ANY replica's traffic skewed" as
-        # the familiar tffm_serve_skew_* series — a per-replica PSI is
-        # already a distribution distance, and the fleet's worst one is
-        # the honest aggregate (means would dilute a single skewed
-        # replica N-fold).  skew_examples sums (it is mass, not
-        # distance).
-        for key in ("skew_psi_values", "skew_psi_lengths",
-                    "skew_psi_ids", "skew_psi_scores", "skew_psi_max"):
-            vals = [
-                b.get(key) for (_, b), _i in blocks
-                if isinstance(b.get(key), (int, float))
-            ]
-            if vals:
-                out[key] = round(max(vals), 6)
-        skew_n = [
-            b.get("skew_examples") for (_, b), _i in blocks
-            if isinstance(b.get("skew_examples"), (int, float))
-        ]
-        if skew_n:
-            out["skew_examples"] = int(sum(skew_n))
-        out["fleet_scrape_age_max_s"] = round(
-            max(now - t for (t, _b), _i in blocks), 3
+        scrapes, folded per ``_FLEET_SPEC`` (including the scrape
+        staleness age the alert plane watches)."""
+        return obs.merge_blocks(
+            ServeRouter._FLEET_SPEC,
+            [scrapes[p["index"]] for p in per if p["index"] in scrapes],
+            now,
         )
-        return out
 
     def _build(self, kind: str = "status") -> dict:
         now = time.time()
@@ -1353,44 +1313,32 @@ class ServeRouter:
         record = self._build("status")
         per = record["serve"]["per_replica"]
         lines = [render_prometheus(record).rstrip("\n")]
-        lines.append("# TYPE tffm_serve_replica_healthy gauge")
-        for p in per:
-            lines.append(
-                f'tffm_serve_replica_healthy{{replica="{p["index"]}",'
-                f'port="{p["port"]}"}} {1 if p["healthy"] else 0}'
-            )
-        lines.append("# TYPE tffm_serve_replica_inflight gauge")
-        for p in per:
-            lines.append(
-                f'tffm_serve_replica_inflight{{replica='
-                f'"{p["index"]}"}} {p["inflight"]}'
-            )
-        lines.append("# TYPE tffm_serve_replica_routed_total counter")
-        for p in per:
-            lines.append(
-                f'tffm_serve_replica_routed_total{{replica='
-                f'"{p["index"]}"}} {p["routed"]}'
-            )
+        lines.extend(obs.labeled_lines(
+            "tffm_serve_replica_healthy", "gauge",
+            [({"replica": p["index"], "port": p["port"]},
+              1 if p["healthy"] else 0) for p in per],
+        ))
+        lines.extend(obs.labeled_lines(
+            "tffm_serve_replica_inflight", "gauge",
+            [({"replica": p["index"]}, p["inflight"]) for p in per],
+        ))
+        lines.extend(obs.labeled_lines(
+            "tffm_serve_replica_routed_total", "counter",
+            [({"replica": p["index"]}, p["routed"]) for p in per],
+        ))
         # Fleet scrape re-exposition: the per-replica serve blocks the
         # health loop pulled, as labeled series — one router scrape
         # sees the whole fleet.
         for key, name, mtype in self._REPLICA_SERIES:
-            rows = [p for p in per if key in p]
-            if not rows:
-                continue
-            lines.append(f"# TYPE {name} {mtype}")
-            for p in rows:
-                lines.append(
-                    f'{name}{{replica="{p["index"]}"}} {p[key]}'
-                )
-        rows = [p for p in per if "scrape_age_s" in p]
-        if rows:
-            lines.append("# TYPE tffm_serve_replica_scrape_age_s gauge")
-            for p in rows:
-                lines.append(
-                    f'tffm_serve_replica_scrape_age_s{{replica='
-                    f'"{p["index"]}"}} {p["scrape_age_s"]}'
-                )
+            lines.extend(obs.labeled_lines(name, mtype, [
+                ({"replica": p["index"]}, p[key])
+                for p in per if key in p
+            ]))
+        lines.extend(obs.labeled_lines(
+            "tffm_serve_replica_scrape_age_s", "gauge",
+            [({"replica": p["index"]}, p["scrape_age_s"])
+             for p in per if "scrape_age_s" in p],
+        ))
         return "\n".join(lines) + "\n"
 
     def close(self) -> None:

@@ -1365,8 +1365,15 @@ class Trainer:
                     "resuming data stream at epoch %d, skipping %d batches",
                     resume_epoch, resume_skip,
                 )
+        # One metrics stream per process, like the trace files: rank 0
+        # owns the configured path, ranks > 0 suffix .rankN
+        # (obs.rank_suffix_path — the shared spelling).  Before this
+        # guard every rank of a shared-filesystem fleet APPENDED into
+        # one file and a merged report double-counted the run.
+        rank = jax.process_index()
         metrics_out = (
-            obs.JsonlWriter(cfg.metrics_file) if cfg.metrics_file else None
+            obs.JsonlWriter(obs.rank_suffix_path(cfg.metrics_file, rank))
+            if cfg.metrics_file else None
         )
         pipe_cfg, shard, _ = self._input_plan()
         profiling = False
@@ -1385,7 +1392,7 @@ class Trainer:
                 # Which process of a multi-host fleet wrote this stream:
                 # every process writes its own metrics_file, and the
                 # rank tag is what lets tools/report.py merge them.
-                "rank": jax.process_index(),
+                "rank": rank,
                 "config_fingerprint": _config_fingerprint(cfg),
                 "steps_per_dispatch": k,
                 "ingest_mode": (
@@ -1621,6 +1628,28 @@ class Trainer:
         )
         cache_logged = not cfg.cache_epochs
 
+        # Live training-fleet plane (obs/fleet.py): rank 0 scrapes
+        # every rank's /status on the heartbeat cadence and publishes
+        # the merged `fleet` block + per-rank tffm_train_rank_* series.
+        # Ranks > 0 only SERVE their /status — aggregation is rank 0's.
+        fleet = None
+        if cfg.train_fleet_scrape and rank == 0:
+            fleet = obs.TrainFleet(
+                cfg.train_fleet_scrape.split(","),
+                interval_s=cfg.heartbeat_secs,
+                telemetry=self.telemetry,
+            )
+        elif jax.process_count() > 1 and rank == 0 and cfg.status_port:
+            # A real fleet with live endpoints but no aggregation
+            # plane: nudge, don't act — peer addresses are not
+            # discoverable from here.
+            log.info(
+                "multi-process run with status endpoints but no "
+                "train_fleet_scrape targets; set it to each rank's "
+                "host:port for live fleet aggregation and straggler "
+                "alerts"
+            )
+
         def telemetry_record(kind: str):
             """One structured self-report (heartbeat/final), host-side
             only: counters/gauges/timers — never a device readback, which
@@ -1645,6 +1674,9 @@ class Trainer:
             rec = {
                 "record": kind,
                 "time": now,
+                # Self-identifying for the fleet scrape (and report
+                # merges): which rank produced this record.
+                "rank": rank,
                 "step": stepno,
                 "epoch": self._epoch,
                 "elapsed": round(wall, 3),
@@ -1712,6 +1744,13 @@ class Trainer:
                 rec["trace_dropped_events"] = self.tracer.dropped_events
                 if cfg.trace_rotate_events:
                     rec["trace_windows"] = self.tracer.windows_written
+            if fleet is not None:
+                # The merged fleet view (cached scrape state only —
+                # nothing here blocks on the network, so heartbeat /
+                # status threads stay host-fast).  Alert rules resolve
+                # straggler_ratio / rank_step_skew / exchange_frac /
+                # scrape_age_max_s from this block.
+                rec["fleet"] = fleet.block(now)
             return rec
 
         # Alert watchdog: declarative rules evaluated against every
@@ -1754,6 +1793,12 @@ class Trainer:
                     cfg.status_port, partial(telemetry_record, "status"),
                     telemetry=self.telemetry, host=cfg.status_host,
                     profile=self._ondemand_profile,
+                    # Rank 0 of a fleet decorates /metrics with the
+                    # per-rank tffm_train_rank_* labeled series.
+                    metrics_extra=(
+                        fleet.metrics_lines if fleet is not None
+                        else None
+                    ),
                 )
                 log.info(
                     "status endpoint listening on %s:%d "
@@ -1766,6 +1811,32 @@ class Trainer:
                     "status endpoint failed to bind port %d: %s",
                     cfg.status_port, e,
                 )
+        # Cross-rank exchange probe (train.exchange): a tiny jitted
+        # all-reduce enqueued after every dispatch and blocked on one
+        # dispatch later — the HealthState discipline, so the timing
+        # costs no pipeline bubble.  At parity the previous probe has
+        # long finished and the wait is ~0; a straggling rank shows up
+        # as exactly its lag.  Gated on the fleet plane being on AND a
+        # real multi-device mesh; off-path training is untouched.
+        exchange_probe = None
+        pending_exchange = None
+        t_exch = None
+        if cfg.train_fleet_scrape and self.mesh.size > 1:
+            try:
+                if cfg.lookup == "shardmap":
+                    from fast_tffm_tpu.train import (
+                        shardmap_step as shardmap_lib,
+                    )
+                    exchange_probe = shardmap_lib.make_exchange_probe(
+                        self.mesh
+                    )
+                else:
+                    exchange_probe = sparse_lib.make_exchange_probe(
+                        self.mesh
+                    )
+                t_exch = self.telemetry.timer("train.exchange")
+            except Exception as e:  # noqa: BLE001 - obs must not kill
+                log.warning("train.exchange probe unavailable: %s", e)
         run_exc: Optional[BaseException] = None
         total_trunc = 0
         try:
@@ -1844,6 +1915,17 @@ class Trainer:
                     # sentinel stamps on `record: compile` entries.
                     self._dispatches = dispatch_idx
                     self._run_steps = stepno
+                    # Exchange timing, one dispatch delayed: enqueue
+                    # THIS dispatch's barrier probe (it runs behind the
+                    # dispatch on every rank's stream), then block on
+                    # the PREVIOUS one — already resolved at parity, so
+                    # the wait measures only cross-rank lag.
+                    if exchange_probe is not None:
+                        probe_out = exchange_probe()
+                        if pending_exchange is not None:
+                            with t_exch.time():
+                                jax.block_until_ready(pending_exchange)
+                        pending_exchange = probe_out
                     # Health readback, one dispatch delayed: start an
                     # async D2H copy of THIS dispatch's scalars, then
                     # consume the PREVIOUS dispatch's (already resident —
@@ -2017,6 +2099,11 @@ class Trainer:
                     heartbeat.close()
                 if status_server is not None:
                     status_server.close()
+                if fleet is not None:
+                    # Stop scraping; the cached state stays readable —
+                    # the final record (outer finally) still carries
+                    # the last merged fleet view.
+                    fleet.close()
                 if self.tiered is not None:
                     # Wake a transfer thread blocked on a write-back
                     # fill that will never come — prefetcher.close()

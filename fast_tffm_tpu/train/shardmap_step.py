@@ -337,6 +337,39 @@ def sparse_step_shardmap(cfg: FmConfig, params, opt_state, batch: Batch,
     return new_params, opt_new, scores
 
 
+def make_exchange_probe(mesh):
+    """Cross-rank barrier probe for the shard_map path: the same
+    contract as train.sparse.make_exchange_probe, but lowered through
+    an explicit ``psum`` over both mesh axes — the collective family
+    THIS step uses (partial-terms psum / delta psum), so the probe's
+    barrier rides the same channel as the step's exchange.  The
+    dispatch loop enqueues it after each dispatch and blocks one
+    dispatch later (``train.exchange`` timer; no pipeline bubble)."""
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from fast_tffm_tpu.platform import shard_map
+
+    spec = P((DATA_AXIS, MODEL_AXIS))
+    reduce = jax.jit(shard_map(
+        lambda x: jax.lax.psum(
+            jnp.sum(x), (DATA_AXIS, MODEL_AXIS)
+        ),
+        mesh=mesh, in_specs=spec, out_specs=P(),
+        check_vma=False,
+    ))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec),
+        np.ones((mesh.local_mesh.size,), np.float32),
+        (mesh.size,),
+    )
+
+    def probe():
+        return reduce(arr)
+
+    return probe
+
+
 def _apply_stream(cfg, tile_start, u, w_l, opt_tables_l):
     """Optimizer update from a merged K2 entry stream (entries exchange).
 

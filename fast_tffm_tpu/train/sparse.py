@@ -294,6 +294,42 @@ def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows,
 _APPLY = {"adagrad": _apply_adagrad, "ftrl": _apply_ftrl, "sgd": _apply_sgd}
 
 
+def make_exchange_probe(mesh):
+    """Cross-rank barrier probe for the GSPMD sparse path: a tiny
+    jitted all-reduce (one float per device, summed to a replicated
+    scalar — GSPMD lowers it to the same all-reduce family the
+    sharded apply's psum uses) that the dispatch loop enqueues right
+    after each dispatch and blocks on ONE DISPATCH LATER (the
+    HealthState discipline — no pipeline bubble).  Because the probe
+    is enqueued behind the dispatch on every rank's stream, the
+    delayed blocking wait measures exactly the straggler-induced
+    collective wall: ~0 when the fleet is in step, the slowest rank's
+    lag otherwise.  Feeds the ``train.exchange`` timer and the fleet
+    block's ``exchange_frac``.
+
+    Returns ``probe() -> jax.Array`` (async; callers block on the
+    result to time the barrier)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(
+        mesh, P((mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS))
+    )
+    arr = jax.make_array_from_process_local_data(
+        sharding,
+        np.ones((mesh.local_mesh.size,), np.float32),
+        (mesh.size,),
+    )
+    reduce = jax.jit(
+        jnp.sum, out_shardings=NamedSharding(mesh, P())
+    )
+
+    def probe():
+        return reduce(arr)
+
+    return probe
+
+
 def grad_health(g_rows, dw0):
     """(grad_sq, nonfinite_count) for a step's gradients — the on-device
     training-health aux the scan carry accumulates (train.loop).

@@ -21,6 +21,10 @@ _WORKER = r"""
 import os, sys
 import jax
 jax.config.update("jax_platforms", "cpu")
+# CPU cross-process collectives need the gloo transport; without it
+# every multi-process computation fails with "Multiprocess
+# computations aren't implemented on the CPU backend".
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=sys.argv[1],
     num_processes=2,
@@ -144,6 +148,10 @@ _WORKER_FILES = r"""
 import sys
 import jax
 jax.config.update("jax_platforms", "cpu")
+# CPU cross-process collectives need the gloo transport; without it
+# every multi-process computation fails with "Multiprocess
+# computations aren't implemented on the CPU backend".
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=sys.argv[1],
     num_processes=2,

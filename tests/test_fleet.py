@@ -1,0 +1,623 @@
+"""Training-fleet observability (ISSUE 18): the shared fleet
+aggregation core, the TrainFleet live plane, and its alert wiring.
+
+Pins:
+
+  * ``merge_blocks`` — the one merge implementation both planes
+    consume: sums / weighted means / MAX tails / plain means /
+    same-name MAX + int-sum groups, staleness age, the empty-scrape
+    shape, absent-key discipline (no lying zeros);
+  * the serve router's ``_FLEET_SPEC`` reproduces the legacy
+    ``_fleet_aggregates`` output exactly (regression pin for the
+    extraction — the two planes cannot drift);
+  * ``labeled_lines`` — the one labeled-series renderer (header +
+    escaping + skip-when-empty);
+  * ``TrainFleet`` — straggler attribution with an injected slow rank,
+    rank_step_skew, exchange_frac max-merge, target death degrading to
+    staleness (never a crash) against REAL StatusServers;
+  * the alert plane — ``straggler_ratio`` fires on breach and stays
+    quiet at parity; ``fleet_scrape_age_max_s`` resolves through the
+    fleet block (and still through serve.*);
+  * config — fleet-plane alert rules are refused while
+    ``train_fleet_scrape`` is unset (the inert-rule discipline), bad
+    targets and heartbeat_secs=0 are rejected;
+  * ``rank_suffix_path`` — per-rank file suffixing (the writer
+    double-count fix);
+  * the cross-rank exchange probe builds and reduces correctly on the
+    8-device mesh for both lookup impls;
+  * fleet plane off -> training state is bitwise identical.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu import obs
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.obs import fleet as fleet_lib
+from fast_tffm_tpu.obs.alerts import AlertEngine, parse_rules
+from fast_tffm_tpu.obs.status import StatusServer
+
+
+# ---------------------------------------------------------------------------
+# merge_blocks semantics
+# ---------------------------------------------------------------------------
+
+
+_SPEC = obs.MergeSpec(
+    sums=("requests",),
+    weighted=("p50_ms",),
+    weight_key="requests",
+    tails=("p99_ms",),
+    means=("batch_fill",),
+    max_same=("skew_psi_max",),
+    sum_same_int=("skew_examples",),
+)
+
+
+class TestMergeBlocks:
+    def test_empty_scrape_shape(self):
+        assert obs.merge_blocks(_SPEC, [], now=10.0) == {
+            "replicas_scraped": 0
+        }
+
+    def test_sums_weighted_tails_means(self):
+        now = 100.0
+        blocks = [
+            (99.0, {"requests": 100, "p50_ms": 10.0, "p99_ms": 50.0,
+                    "batch_fill": 0.5, "skew_psi_max": 0.1,
+                    "skew_examples": 7}),
+            (98.0, {"requests": 300, "p50_ms": 20.0, "p99_ms": 40.0,
+                    "batch_fill": 0.7, "skew_psi_max": 0.3,
+                    "skew_examples": 5}),
+        ]
+        out = obs.merge_blocks(_SPEC, blocks, now)
+        assert out["replicas_scraped"] == 2
+        assert out["fleet_requests"] == 400
+        # Request-weighted p50: (10*100 + 20*300) / 400.
+        assert out["fleet_p50_ms"] == 17.5
+        # Tails MAX-merge (a merged p99 can't be computed from
+        # per-member percentiles).
+        assert out["fleet_p99_ms"] == 50.0
+        assert out["fleet_batch_fill"] == pytest.approx(0.6)
+        # Same-name groups: PSI is max-merged, mass is summed.
+        assert out["skew_psi_max"] == 0.3
+        assert out["skew_examples"] == 12
+        # Staleness: the OLDEST member's age.
+        assert out["fleet_scrape_age_max_s"] == 2.0
+
+    def test_absent_keys_contribute_nothing(self):
+        out = obs.merge_blocks(
+            _SPEC, [(9.0, {"requests": 4})], now=10.0
+        )
+        assert "fleet_p50_ms" not in out
+        assert "fleet_p99_ms" not in out
+        assert "fleet_batch_fill" not in out
+        assert "skew_psi_max" not in out
+        assert out["fleet_requests"] == 4
+
+    def test_non_numeric_values_skipped(self):
+        out = obs.merge_blocks(
+            _SPEC,
+            [(9.0, {"requests": "lots", "p99_ms": 5.0}),
+             (9.5, {"requests": 3, "p99_ms": "slow"})],
+            now=10.0,
+        )
+        assert out["fleet_requests"] == 3
+        assert out["fleet_p99_ms"] == 5.0
+
+    def test_idle_member_still_weighs_one(self):
+        # weight max(1, requests): an idle member (0 requests) cannot
+        # zero out its p50 contribution.
+        out = obs.merge_blocks(
+            _SPEC,
+            [(9.0, {"requests": 0, "p50_ms": 30.0}),
+             (9.0, {"requests": 0, "p50_ms": 10.0})],
+            now=10.0,
+        )
+        assert out["fleet_p50_ms"] == 20.0
+
+
+class TestRouterSpecRegression:
+    """The extracted spec reproduces the legacy router aggregation
+    byte-for-byte — the drift pin the shared core exists for."""
+
+    def _legacy(self, blocks, now):
+        # The pre-extraction serve/router.py _fleet_aggregates body,
+        # kept verbatim as the regression oracle.
+        if not blocks:
+            return {"replicas_scraped": 0}
+        out = {"replicas_scraped": len(blocks)}
+        for key in ("requests", "examples", "batches", "qps",
+                    "steady_compiles", "recompiles_unexpected"):
+            vals = [b.get(key) for _t, b in blocks]
+            vals = [v for v in vals if isinstance(v, (int, float))]
+            if vals:
+                out[f"fleet_{key}"] = round(sum(vals), 2)
+        weights = [
+            max(1, int((b.get("requests") or 0))) for _t, b in blocks
+        ]
+        p50s = [
+            (b.get("p50_ms"), w)
+            for (_t, b), w in zip(blocks, weights)
+            if isinstance(b.get("p50_ms"), (int, float))
+        ]
+        if p50s:
+            out["fleet_p50_ms"] = round(
+                sum(v * w for v, w in p50s) / sum(w for _, w in p50s),
+                4,
+            )
+        for key in ("p95_ms", "p99_ms", "max_ms"):
+            vals = [
+                b.get(key) for _t, b in blocks
+                if isinstance(b.get(key), (int, float))
+            ]
+            if vals:
+                out[f"fleet_{key}"] = round(max(vals), 4)
+        fills = [
+            b.get("batch_fill") for _t, b in blocks
+            if isinstance(b.get("batch_fill"), (int, float))
+        ]
+        if fills:
+            out["fleet_batch_fill"] = round(sum(fills) / len(fills), 6)
+        for key in ("skew_psi_values", "skew_psi_lengths",
+                    "skew_psi_ids", "skew_psi_scores", "skew_psi_max"):
+            vals = [
+                b.get(key) for _t, b in blocks
+                if isinstance(b.get(key), (int, float))
+            ]
+            if vals:
+                out[key] = round(max(vals), 6)
+        skew_n = [
+            b.get("skew_examples") for _t, b in blocks
+            if isinstance(b.get("skew_examples"), (int, float))
+        ]
+        if skew_n:
+            out["skew_examples"] = int(sum(skew_n))
+        out["fleet_scrape_age_max_s"] = round(
+            max(now - t for t, _b in blocks), 3
+        )
+        return out
+
+    def test_matches_legacy_on_rich_blocks(self):
+        from fast_tffm_tpu.serve.router import ServeRouter
+
+        rng = np.random.default_rng(7)
+        now = 1000.0
+        blocks = []
+        for i in range(5):
+            b = {
+                "requests": int(rng.integers(0, 500)),
+                "examples": int(rng.integers(0, 9000)),
+                "batches": int(rng.integers(0, 200)),
+                "qps": round(float(rng.uniform(0, 900)), 2),
+                "steady_compiles": int(rng.integers(0, 3)),
+                "recompiles_unexpected": int(rng.integers(0, 2)),
+                "p50_ms": round(float(rng.uniform(1, 20)), 4),
+                "p95_ms": round(float(rng.uniform(20, 40)), 4),
+                "p99_ms": round(float(rng.uniform(40, 80)), 4),
+                "max_ms": round(float(rng.uniform(80, 200)), 4),
+                "batch_fill": round(float(rng.uniform(0, 1)), 6),
+                "skew_psi_values": round(float(rng.uniform(0, 1)), 6),
+                "skew_psi_max": round(float(rng.uniform(0, 1)), 6),
+                "skew_examples": int(rng.integers(0, 4000)),
+            }
+            # Member 3 is sparse: only a counter (absent-key paths).
+            if i == 3:
+                b = {"requests": b["requests"]}
+            blocks.append((now - float(rng.uniform(0, 5)), b))
+        legacy = self._legacy(blocks, now)
+        shared = obs.merge_blocks(ServeRouter._FLEET_SPEC, blocks, now)
+        assert shared == legacy
+
+    def test_matches_legacy_empty(self):
+        from fast_tffm_tpu.serve.router import ServeRouter
+
+        assert obs.merge_blocks(
+            ServeRouter._FLEET_SPEC, [], 5.0
+        ) == self._legacy([], 5.0)
+
+
+class TestLabeledLines:
+    def test_header_and_samples(self):
+        lines = obs.labeled_lines(
+            "tffm_x", "gauge",
+            [({"rank": 0}, 1.5), ({"rank": 1, "port": 80}, 2)],
+        )
+        assert lines == [
+            "# TYPE tffm_x gauge",
+            'tffm_x{rank="0"} 1.5',
+            'tffm_x{rank="1",port="80"} 2',
+        ]
+
+    def test_empty_renders_nothing(self):
+        assert obs.labeled_lines("tffm_x", "gauge", []) == []
+
+    def test_label_escaping(self):
+        lines = obs.labeled_lines(
+            "tffm_x", "gauge", [({"host": 'a"b\\c'}, 1)]
+        )
+        assert lines[1] == 'tffm_x{host="a\\"b\\\\c"} 1'
+
+
+# ---------------------------------------------------------------------------
+# TrainFleet
+# ---------------------------------------------------------------------------
+
+
+def _rank_status(rank, step, dispatch_mean_ms, dispatch_count=10,
+                 wait_mean_ms=1.0, examples=1000, elapsed=50.0,
+                 exchange_total_s=None):
+    """A /status record shaped like the trainer's heartbeat record."""
+    total_s = dispatch_mean_ms * dispatch_count / 1000.0
+    timers = {
+        "train.dispatch": {
+            "count": dispatch_count, "total_s": round(total_s, 6),
+            "mean_ms": dispatch_mean_ms,
+            "p99_ms": dispatch_mean_ms * 1.2,
+        },
+        "train.wait_input": {
+            "count": dispatch_count, "total_s": 0.01,
+            "mean_ms": wait_mean_ms, "p99_ms": wait_mean_ms * 2,
+        },
+    }
+    if exchange_total_s is not None:
+        timers["train.exchange"] = {
+            "count": dispatch_count, "total_s": exchange_total_s,
+            "mean_ms": exchange_total_s / dispatch_count * 1000,
+            "p99_ms": exchange_total_s / dispatch_count * 1200,
+        }
+    return {
+        "record": "status", "rank": rank, "step": step,
+        "elapsed": elapsed, "examples_in": examples,
+        "ingest_wait_frac": 0.01,
+        "stages": {"timers": timers},
+    }
+
+
+def _fake_fleet(records):
+    """A TrainFleet over fake targets served from a dict."""
+    return obs.TrainFleet(
+        list(records), fetch=lambda t: records[t], start=False
+    )
+
+
+class TestTrainFleet:
+    def test_straggler_attribution(self):
+        # Rank 1 dispatches 3x slower than the other two.
+        records = {
+            "r0": _rank_status(0, 40, dispatch_mean_ms=100.0),
+            "r1": _rank_status(1, 38, dispatch_mean_ms=300.0),
+            "r2": _rank_status(2, 40, dispatch_mean_ms=100.0),
+        }
+        fl = _fake_fleet(records)
+        assert fl.scrape_once() == 3
+        block = fl.block()
+        fleet_mean = (100 + 300 + 100) / 3
+        assert block["ranks_scraped"] == 3
+        assert block["straggler_ratio"] == pytest.approx(
+            300 / fleet_mean, abs=1e-4
+        )
+        assert block["slowest_rank"] == 1
+        assert block["slowest_rank_share"] == pytest.approx(
+            0.6, abs=1e-4
+        )
+        assert block["dispatch_skew_ms"] == pytest.approx(200.0)
+        assert block["rank_step_skew"] == 2
+        assert block["examples_in"] == 3000
+
+    def test_parity_reads_one(self):
+        records = {
+            f"r{i}": _rank_status(i, 40, dispatch_mean_ms=100.0)
+            for i in range(3)
+        }
+        fl = _fake_fleet(records)
+        fl.scrape_once()
+        block = fl.block()
+        assert block["straggler_ratio"] == 1.0
+        assert block["rank_step_skew"] == 0
+        assert block["dispatch_skew_ms"] == 0.0
+
+    def test_exchange_frac_is_worst_rank(self):
+        records = {
+            "r0": _rank_status(0, 10, 100.0, elapsed=100.0,
+                               exchange_total_s=1.0),
+            "r1": _rank_status(1, 10, 100.0, elapsed=100.0,
+                               exchange_total_s=30.0),
+        }
+        fl = _fake_fleet(records)
+        fl.scrape_once()
+        block = fl.block()
+        # max(1/100, 30/100) — one rank stuck at the barrier IS the
+        # signal; a mean would dilute it.
+        assert block["exchange_frac"] == pytest.approx(0.3)
+        assert "exchange_p99_ms" in block
+
+    def test_metrics_lines_labeled_per_rank(self):
+        records = {
+            "r0": _rank_status(0, 40, 100.0),
+            "r1": _rank_status(1, 38, 300.0),
+        }
+        fl = _fake_fleet(records)
+        fl.scrape_once()
+        text = fl.metrics_lines()
+        assert "# TYPE tffm_train_rank_step gauge" in text
+        assert 'tffm_train_rank_step{rank="0"} 40' in text
+        assert 'tffm_train_rank_step{rank="1"} 38' in text
+        assert (
+            'tffm_train_rank_dispatch_mean_ms{rank="1"} 300.0' in text
+        )
+        assert 'tffm_train_rank_examples_total{rank="0"} 1000' in text
+
+    def test_failed_fetch_keeps_previous_and_counts_error(self):
+        tel = obs.Telemetry()
+        calls = {"n": 0}
+
+        def fetch(target):
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise OSError("rank died")
+            return _rank_status(0, 40, 100.0)
+
+        fl = obs.TrainFleet(
+            ["r0"], telemetry=tel, fetch=fetch, start=False
+        )
+        assert fl.scrape_once() == 1
+        t_first = fl._latest["r0"][0]
+        assert fl.scrape_once() == 0  # death -> kept, not crashed
+        assert fl._latest["r0"][0] == t_first
+        block = fl.block(now=t_first + 30.0)
+        assert block["ranks_scraped"] == 1
+        assert block["scrape_age_max_s"] == pytest.approx(30.0)
+        snap = tel.snapshot()
+        assert snap["counters"]["train.fleet_scrape_errors"] == 1
+        assert snap["timers"]["train.fleet_scrape"]["count"] == 2
+
+    def test_real_statusserver_death_degrades_to_staleness(self):
+        recs = [_rank_status(i, 40, 100.0) for i in range(2)]
+        servers = [
+            StatusServer(0, (lambda r: (lambda: r))(r)) for r in recs
+        ]
+        try:
+            fl = obs.TrainFleet(
+                [f"127.0.0.1:{s.port}" for s in servers], start=False,
+                timeout=2.0,
+            )
+            assert fl.scrape_once() == 2
+            assert fl.block()["ranks_scraped"] == 2
+            servers[1].close()  # rank 1 dies
+            assert fl.scrape_once() == 1
+            block = fl.block()
+            # Still two ranks in the view; the dead one only ages.
+            assert block["ranks_scraped"] == 2
+            assert block["scrape_age_max_s"] >= 0
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_scrape_thread_lifecycle(self):
+        records = {"r0": _rank_status(0, 1, 100.0)}
+        fl = obs.TrainFleet(
+            ["r0"], interval_s=0.01, fetch=lambda t: records[t]
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline and not fl.rank_rows():
+            time.sleep(0.01)
+        assert fl.rank_rows(), "scrape thread never populated state"
+        fl.close()
+        assert fl._thread is None
+
+
+# ---------------------------------------------------------------------------
+# Alert wiring
+# ---------------------------------------------------------------------------
+
+
+def _fleet_rec(**fleet):
+    return {"record": "heartbeat", "step": 5, "fleet": fleet}
+
+
+class TestFleetAlerts:
+    def test_straggler_rule_fires_and_stays_quiet(self):
+        eng = AlertEngine(
+            parse_rules("straggler_ratio > 1.4 for 2 : warn")
+        )
+        # Parity: quiet.
+        assert eng.observe(_fleet_rec(straggler_ratio=1.0)) == []
+        assert eng.observe(_fleet_rec(straggler_ratio=1.05)) == []
+        # Breach must sustain 2 records.
+        assert eng.observe(_fleet_rec(straggler_ratio=2.0)) == []
+        fired = eng.observe(_fleet_rec(straggler_ratio=2.1))
+        assert len(fired) == 1
+        assert fired[0]["signal"] == "straggler_ratio"
+        assert fired[0]["value"] == 2.1
+
+    def test_rank_step_skew_and_exchange_frac_resolve(self):
+        eng = AlertEngine(parse_rules(
+            "rank_step_skew > 3 : warn; exchange_frac > 0.5 : warn"
+        ))
+        fired = eng.observe(
+            _fleet_rec(rank_step_skew=8, exchange_frac=0.9)
+        )
+        assert {a["signal"] for a in fired} == {
+            "rank_step_skew", "exchange_frac"
+        }
+
+    def test_scrape_age_resolves_fleet_and_serve(self):
+        eng = AlertEngine(
+            parse_rules("fleet_scrape_age_max_s > 10 : warn")
+        )
+        # Training fleet spelling (fleet.scrape_age_max_s fallback).
+        assert len(eng.observe(
+            _fleet_rec(scrape_age_max_s=60.0)
+        )) == 1
+        # Serving spelling (the primary alias) still works.
+        eng2 = AlertEngine(
+            parse_rules("fleet_scrape_age_max_s > 10 : warn")
+        )
+        rec = {"record": "heartbeat", "step": 1,
+               "serve": {"fleet_scrape_age_max_s": 60.0}}
+        assert len(eng2.observe(rec)) == 1
+
+    def test_missing_fleet_block_is_quiet(self):
+        eng = AlertEngine(
+            parse_rules("straggler_ratio > 1.4 : warn")
+        )
+        assert eng.observe({"record": "heartbeat", "step": 1}) == []
+
+
+# ---------------------------------------------------------------------------
+# Config discipline
+# ---------------------------------------------------------------------------
+
+
+def _base_cfg(tmp_path, **kw):
+    defaults = dict(
+        vocabulary_size=64, factor_num=4, max_features=4,
+        batch_size=16, model_file=str(tmp_path / "model"),
+        log_steps=0,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+class TestConfig:
+    def test_fleet_rules_refused_when_plane_off(self, tmp_path):
+        for rule in ("straggler_ratio > 1.5 : warn",
+                     "rank_step_skew > 4 : halt",
+                     "exchange_frac > 0.5 : warn"):
+            with pytest.raises(ValueError, match="train_fleet_scrape"):
+                _base_cfg(
+                    tmp_path, heartbeat_secs=5, alert_rules=rule
+                )
+
+    def test_fleet_rules_accepted_when_plane_on(self, tmp_path):
+        cfg = _base_cfg(
+            tmp_path,
+            heartbeat_secs=5,
+            train_fleet_scrape="127.0.0.1:8100,127.0.0.1:8101",
+            alert_rules="straggler_ratio > 1.5 for 2 : warn",
+        )
+        assert cfg.train_fleet_scrape.count(",") == 1
+
+    def test_scrape_needs_heartbeat(self, tmp_path):
+        with pytest.raises(ValueError, match="heartbeat_secs"):
+            _base_cfg(tmp_path, train_fleet_scrape="127.0.0.1:8100")
+
+    def test_bad_targets_rejected(self, tmp_path):
+        for bad in ("localhost", "127.0.0.1:notaport",
+                    "127.0.0.1:0", ":9", "127.0.0.1:70000"):
+            with pytest.raises(ValueError, match="train_fleet_scrape"):
+                _base_cfg(
+                    tmp_path, heartbeat_secs=5, train_fleet_scrape=bad
+                )
+
+    def test_age_rule_stays_serve_gated(self, tmp_path):
+        # fleet_scrape_age_max_s primarily aliases the SERVE plane —
+        # it must stay accepted with serve fleet config and no
+        # train_fleet_scrape (back-compat for PR 13 rule files).
+        cfg = _base_cfg(
+            tmp_path, heartbeat_secs=5, serve_replicas=2,
+            alert_rules="fleet_scrape_age_max_s > 30 : warn",
+        )
+        assert cfg.serve_replicas == 2
+
+
+# ---------------------------------------------------------------------------
+# rank-suffixed writer paths
+# ---------------------------------------------------------------------------
+
+
+class TestRankSuffix:
+    def test_rank_zero_and_empty_unchanged(self):
+        assert obs.rank_suffix_path("/tmp/m.jsonl", 0) == "/tmp/m.jsonl"
+        assert obs.rank_suffix_path("", 3) == ""
+
+    def test_nonzero_ranks_suffixed(self):
+        assert (
+            obs.rank_suffix_path("/tmp/m.jsonl", 1) == "/tmp/m.jsonl.rank1"
+        )
+        assert (
+            obs.rank_suffix_path("/tmp/m.jsonl", 7) == "/tmp/m.jsonl.rank7"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Exchange probe + bitwise-off parity (the jax-touching part)
+# ---------------------------------------------------------------------------
+
+
+class TestExchangeProbe:
+    @pytest.mark.parametrize("impl", ["gspmd", "shardmap"])
+    def test_probe_reduces_to_device_count(self, tmp_path, impl):
+        import jax
+
+        from fast_tffm_tpu.parallel import mesh as mesh_lib
+
+        cfg = _base_cfg(tmp_path, mesh_data=4, mesh_model=2)
+        mesh = mesh_lib.make_mesh(cfg)
+        if impl == "gspmd":
+            from fast_tffm_tpu.train import sparse as lib
+        else:
+            from fast_tffm_tpu.train import shardmap_step as lib
+        probe = lib.make_exchange_probe(mesh)
+        out = probe()
+        jax.block_until_ready(out)
+        assert float(out) == float(mesh.size)
+        # Repeat dispatches reuse the compiled probe.
+        assert float(probe()) == float(mesh.size)
+
+
+class TestFleetOffBitwise:
+    def test_fleet_plane_off_is_bitwise_identical(self, tmp_path):
+        """train_fleet_scrape on (scraping itself, exchange probe
+        live) vs off: identical final table bits."""
+        import jax
+
+        from fast_tffm_tpu.train.loop import Trainer
+
+        def _write_data(path):
+            rng = np.random.default_rng(0)
+            with open(path, "w") as f:
+                for _ in range(256):
+                    feats = rng.choice(50, size=3, replace=False)
+                    toks = " ".join(
+                        f"{i}:{rng.uniform(0.1, 1):.3f}" for i in feats
+                    )
+                    f.write(f"{rng.integers(0, 2)} {toks}\n")
+            return str(path)
+
+        data = _write_data(tmp_path / "train.libsvm")
+        tables = {}
+        for tag in ("on", "off"):
+            kw = dict(
+                vocabulary_size=50, factor_num=4, max_features=4,
+                batch_size=32, epoch_num=1, thread_num=2,
+                steps_per_dispatch=4, seed=3, log_steps=0,
+                model_file=str(tmp_path / f"model_{tag}"),
+                train_files=[data],
+            )
+            if tag == "on":
+                port = _free_port()
+                kw.update(
+                    status_port=port, heartbeat_secs=0.2,
+                    train_fleet_scrape=f"127.0.0.1:{port}",
+                )
+            t = Trainer(kw.pop("_unused", None) or FmConfig(**kw))
+            t.train()
+            tables[tag] = np.asarray(t.state.params.table)
+        np.testing.assert_array_equal(tables["on"], tables["off"])
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -769,6 +769,208 @@ def check_router(cfg_path: str, data: str) -> None:
     )
 
 
+# Two-rank fleet-training worker (ISSUE 18): the ranks join a
+# loopback jax.distributed cluster for IDENTITY (process_index,
+# rank-suffixed streams) but each trains on its own LOCAL 2x1 mesh —
+# lock-step SPMD would synchronize every dispatch through the
+# all-reduce and smear the injected straggler's latency across BOTH
+# ranks' dispatch timers (ratio ~= 1.0 however slow the straggler),
+# which is exactly the single-host drive mode the explicit
+# train_fleet_scrape target list exists for.  Rank 1 sleeps 80 ms per
+# dispatch (the injected straggler); rank 0 runs the TrainFleet
+# aggregator over both ranks with a live straggler_ratio rule.
+_FLEET_WORKER = r"""
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+# CPU cross-process collectives need the gloo transport; without it
+# any multi-process computation fails with "Multiprocess computations
+# aren't implemented on the CPU backend".  Training here is local per
+# rank, but checkpoint-save barriers still cross processes.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+tmpdir, port0, port1 = sys.argv[3], int(sys.argv[4]), int(sys.argv[5])
+rank = jax.process_index()
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.parallel import mesh as mesh_lib
+from fast_tffm_tpu.train.loop import Trainer
+
+cfg = FmConfig(
+    vocabulary_size=64, factor_num=4, max_features=4, batch_size=64,
+    mesh_data=2, mesh_model=1,
+    train_files=[tmpdir + "/fleet.libsvm"],
+    model_file=tmpdir + "/fleet_model%d" % rank,
+    epoch_num=24, log_steps=0, thread_num=1, seed=5,
+    heartbeat_secs=0.2,
+    metrics_file=tmpdir + "/fleet_metrics.jsonl",
+    status_port=port0 if rank == 0 else port1,
+    train_fleet_scrape="127.0.0.1:%d,127.0.0.1:%d" % (port0, port1),
+    alert_rules="straggler_ratio > 1.4 for 2 : warn",
+)
+trainer = Trainer(
+    cfg, mesh=mesh_lib.make_mesh(cfg, jax.local_devices())
+)
+# Orbax refuses host-local arrays when process_count > 1, and this
+# smoke exercises the fleet plane, not checkpointing.
+trainer.save = lambda stepno: None
+if rank == 1:
+    real = trainer._scan_train_step
+    def slow(state, batches):
+        time.sleep(0.08)
+        return real(state, batches)
+    trainer._scan_train_step = slow
+trainer.train()
+print("FLEET_RANK_DONE", rank)
+"""
+
+
+def check_fleet(tmpdir: str) -> None:
+    """2-rank fleet-training smoke: rank 0 aggregates the fleet LIVE
+    (per-rank ``tffm_train_rank_*`` series on its /metrics, merged
+    ``fleet`` block on /status), the injected 60 ms straggler on rank 1
+    trips the ``straggler_ratio`` alert while training runs, and the
+    per-rank JSONL writers never double-count into one stream."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    data = os.path.join(tmpdir, "fleet.libsvm")
+    with open(data, "w") as f:
+        for _ in range(512):
+            toks = [str(rng.integers(0, 2))]
+            toks += [f"{rng.integers(0, 64)}:{rng.uniform(0.1, 1):.4f}"
+                     for _ in range(3)]
+            f.write(" ".join(toks) + "\n")
+    coord_port, port0, port1 = _free_port(), _free_port(), _free_port()
+    script = os.path.join(tmpdir, "fleet_worker.py")
+    with open(script, "w") as f:
+        f.write(_FLEET_WORKER)
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get(
+            "PYTHONPATH", ""
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script,
+             f"127.0.0.1:{coord_port}", str(i), tmpdir,
+             str(port0), str(port1)],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    try:
+        # Live assertion window: rank 0's /metrics must grow BOTH
+        # ranks' labeled series plus the merged fleet aggregates while
+        # the ranks are still training.
+        deadline = time.time() + 240
+        fleet_metrics = None
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break  # a fast box may finish before we catch it live
+            try:
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port0}/metrics", timeout=2
+                ).read().decode()
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+                continue
+            if ('tffm_train_rank_dispatch_mean_ms{rank="1"}' in text
+                    and "tffm_fleet_straggler_ratio" in text):
+                fleet_metrics = text
+                break
+            time.sleep(0.2)
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+            if p.returncode != 0:
+                sys.stderr.write(outs[-1][-3000:])
+                raise SystemExit(
+                    f"FAIL: fleet worker exited {p.returncode}"
+                )
+        if fleet_metrics is None:
+            raise SystemExit(
+                "FAIL: rank 0 /metrics never served the per-rank "
+                "fleet series mid-run"
+            )
+        check_prometheus(fleet_metrics)
+        for series in ('tffm_train_rank_step{rank="0"}',
+                       'tffm_train_rank_step{rank="1"}',
+                       "tffm_fleet_ranks_scraped 2",
+                       "tffm_fleet_straggler_ratio"):
+            if series not in fleet_metrics:
+                raise SystemExit(
+                    f"FAIL: fleet /metrics missing {series!r}"
+                )
+        # Rank files: rank 0 owns metrics.jsonl, rank 1 the .rank1
+        # suffix — merged streams must never double-count.
+        rank0_path = os.path.join(tmpdir, "fleet_metrics.jsonl")
+        rank1_path = rank0_path + ".rank1"
+        for path in (rank0_path, rank1_path):
+            if not os.path.exists(path):
+                raise SystemExit(f"FAIL: missing rank stream {path}")
+        recs0 = [json.loads(line) for line in open(rank0_path)]
+        ranks0 = {r.get("rank") for r in recs0 if "rank" in r}
+        if ranks0 - {0}:
+            raise SystemExit(
+                f"FAIL: rank-0 stream carries foreign ranks {ranks0}"
+            )
+        recs1 = [json.loads(line) for line in open(rank1_path)]
+        if not any(r.get("rank") == 1 for r in recs1):
+            raise SystemExit(
+                "FAIL: rank-1 stream has no rank-1 records"
+            )
+        # The LIVE alert: the injected straggler must have fired the
+        # straggler_ratio rule into rank 0's stream during the run.
+        alerts = [r for r in recs0 if r.get("record") == "alert"]
+        stragglers = [
+            a for a in alerts if a.get("signal") == "straggler_ratio"
+        ]
+        if not stragglers:
+            raise SystemExit(
+                f"FAIL: no straggler_ratio alert fired "
+                f"(alerts: {alerts})"
+            )
+        if stragglers[0]["value"] <= 1.4:
+            raise SystemExit(
+                f"FAIL: straggler alert fired below threshold: "
+                f"{stragglers[0]}"
+            )
+        # The final record carries the merged fleet view.
+        final = [r for r in recs0 if r.get("record") == "final"][-1]
+        fl = final.get("fleet") or {}
+        if fl.get("ranks_scraped") != 2:
+            raise SystemExit(
+                f"FAIL: final fleet block incomplete: {fl}"
+            )
+        if fl.get("slowest_rank") != 1:
+            raise SystemExit(
+                f"FAIL: straggler attribution blamed rank "
+                f"{fl.get('slowest_rank')}, expected 1: {fl}"
+            )
+        print(
+            f"fleet smoke ok: 2 ranks aggregated live, "
+            f"straggler_ratio={stragglers[0]['value']} alert fired "
+            f"(slowest_rank={fl['slowest_rank']}), "
+            f"{len(recs1)} rank-1 records in .rank1"
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
 def main() -> int:
     port = _free_port()
     tmpdir = tempfile.mkdtemp(prefix="tffm_obs_smoke_")
@@ -877,6 +1079,9 @@ max_features = 4
     # smoke mounts a 2-replica fleet over the same checkpoint.
     check_serve(cfg_path, data)
     check_router(cfg_path, data)
+    # Fleet-training smoke (ISSUE 18): 2 spawned CPU ranks, rank 0
+    # aggregating, an injected straggler tripping the live alert.
+    check_fleet(tmpdir)
     return 0
 
 

@@ -56,6 +56,8 @@ from __future__ import annotations
 import argparse
 import bisect
 import json
+import os
+import re
 import sys
 
 
@@ -1136,6 +1138,21 @@ _DIRECTION_OVERRIDES = {
     # itself is the same work either way (informational).
     "serve.warmup_wall_s": "low",
     "serve.warmup_compile_s": None,
+    # Training-fleet observability (ISSUE 18): straggler ratio / skews
+    # / the exchange barrier fraction regress when they RISE (one rank
+    # slowing the fleet), as does the paired fleet-scrape overhead
+    # ratio (off/on rate, same shape as the other obs cost probes).
+    # Which rank is slowest, how many answered, and the scrape
+    # staleness (cadence-bound) are informational.
+    "fleet.straggler_ratio": "low", "fleet.rank_step_skew": "low",
+    "fleet.exchange_frac": "low",
+    "fleet.dispatch_skew_ms": "low", "fleet.wait_skew_ms": "low",
+    "fleet.dispatch_p99_ms": "low", "fleet.wait_p99_ms": "low",
+    "fleet.exchange_p99_ms": "low",
+    "fleet.slowest_rank": None, "fleet.slowest_rank_share": None,
+    "fleet.ranks_scraped": None, "fleet.scrape_age_max_s": None,
+    "fleet.examples_in": None, "fleet.ingest_wait_frac": "low",
+    "fleet_scrape_overhead": "low",
 }
 
 
@@ -1219,6 +1236,13 @@ def _comparable_metrics(path: str) -> dict:
         val = (final.get("quality") or {}).get(key)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             out[f"quality.{key}"] = float(val)
+    # Training-fleet block (ISSUE 18): rank 0's merged cross-rank view
+    # plus the straggler attribution.  Single-process streams carry no
+    # fleet block and contribute no fleet.* keys — the shared-set
+    # back-compat every block follows.
+    for key, val in (final.get("fleet") or {}).items():
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[f"fleet.{key}"] = float(val)
     # Serving skew keys live inside the serve block (skew_*).
     for key in ("skew_psi_values", "skew_psi_lengths", "skew_psi_ids",
                 "skew_psi_scores", "skew_psi_max", "skew_examples"):
@@ -1335,6 +1359,100 @@ def compare_mode(path_a: str, path_b: str, thresholds: dict) -> int:
     return 0
 
 
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals: list) -> str:
+    lo, hi = min(vals), max(vals)
+    if hi == lo:
+        return _SPARK_BLOCKS[3] * len(vals)
+    span = hi - lo
+    return "".join(
+        _SPARK_BLOCKS[
+            min(len(_SPARK_BLOCKS) - 1,
+                int((v - lo) / span * len(_SPARK_BLOCKS)))
+        ]
+        for v in vals
+    )
+
+
+def _bench_order(path: str):
+    """Sort key putting BENCH_r2 before BENCH_r10 (numeric round when
+    the name carries one, lexical otherwise)."""
+    m = re.search(r"_r(\d+)\D*\.json$", os.path.basename(path))
+    return (0, int(m.group(1)), path) if m else (1, 0, path)
+
+
+def timeline_mode(paths: list, thresholds: dict) -> int:
+    """Trend view over a stack of bench JSONs (BENCH_rN.json): one
+    sparkline row per shared key plus first-regression attribution —
+    the earliest round whose step beyond ``--threshold`` moved in the
+    regressing direction for that key (same direction vocabulary as
+    ``--compare``).  Informational: always exits 0."""
+    paths = sorted(paths, key=_bench_order)
+    default = thresholds.get("default", 0.05)
+    series: dict = {}
+    labels = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"{path}: unreadable ({e}); skipped")
+            continue
+        if not isinstance(doc, dict) or "metric" not in doc:
+            # Harness stubs from rounds where the bench never ran
+            # (rc!=0 wrappers) carry no metric keys — skip, don't
+            # fake a flat round.
+            print(f"{os.path.basename(path)}: no bench metrics; "
+                  f"skipped")
+            continue
+        label = os.path.basename(path)
+        labels.append(label)
+        for key, val in doc.items():
+            if isinstance(val, (int, float)) and not isinstance(
+                val, bool
+            ):
+                series.setdefault(key, []).append((label, float(val)))
+    if len(labels) < 2:
+        print("--timeline needs at least two readable bench JSONs")
+        return 1
+    print(f"timeline over {len(labels)} rounds: "
+          f"{labels[0]} .. {labels[-1]} "
+          f"(step threshold {default:.0%})")
+    print(f"  {'key':34} {'trend':>{max(5, len(labels))}} "
+          f"{'first':>10} {'last':>10} {'l/f':>7}  first regression")
+    for key in sorted(series):
+        points = series[key]
+        if len(points) < 2:
+            continue
+        vals = [v for _lab, v in points]
+        direction = _direction(key)
+        threshold = thresholds.get(key, default)
+        # First-regression attribution: the earliest adjacent step
+        # whose ratio moved beyond the threshold the WRONG way.
+        culprit = ""
+        for (lab_a, va), (lab_b, vb) in zip(points, points[1:]):
+            if va == 0 and vb == 0:
+                continue
+            ratio = vb / va if va else float("inf")
+            if (
+                (direction == "low" and ratio > 1 + threshold)
+                or (direction == "high" and ratio < 1 - threshold)
+                or (direction == "both" and not (
+                    1 - threshold <= ratio <= 1 + threshold))
+            ):
+                rs = (f"{ratio:.2f}x" if ratio != float("inf")
+                      else "inf")
+                culprit = f"{lab_a} -> {lab_b} ({rs})"
+                break
+        lf = vals[-1] / vals[0] if vals[0] else float("inf")
+        lfs = f"{lf:7.3f}" if lf != float("inf") else "    inf"
+        print(f"  {key:34} {_sparkline(vals):>{max(5, len(labels))}} "
+              f"{vals[0]:>10.4g} {vals[-1]:>10.4g} {lfs}  {culprit}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="summarize fast_tffm_tpu metrics JSONLs, merge "
@@ -1364,6 +1482,11 @@ def main(argv=None) -> int:
     ap.add_argument("--compare", action="store_true",
                     help="ratio-diff exactly two runs (metrics JSONLs "
                          "or bench JSONs); exit 2 on regression")
+    ap.add_argument("--timeline", action="store_true",
+                    help="trend view over a stack of bench JSONs "
+                         "(BENCH_r*.json): per-key sparkline + "
+                         "first-regression attribution using the "
+                         "--compare direction vocabulary")
     ap.add_argument("--threshold", action="append", default=None,
                     metavar="FLOAT|KEY=FLOAT",
                     help="--compare: regression flag threshold "
@@ -1376,6 +1499,10 @@ def main(argv=None) -> int:
         return serve_trace_mode(args.paths, args.out, args.limit)
     if args.trace:
         return trace_mode(args.paths, args.out, args.limit)
+    if args.timeline:
+        return timeline_mode(
+            args.paths, parse_thresholds(args.threshold)
+        )
     if args.compare:
         if len(args.paths) != 2:
             ap.error("--compare takes exactly two paths")
