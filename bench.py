@@ -823,6 +823,261 @@ def _bench_tiered(workers: int) -> dict:
     return out
 
 
+# Fleet-bench worker (ISSUE 19): one subprocess per role.  "global" is
+# the single-process host-global tiered baseline on a (1 data x 2
+# model) mesh; "fleet" is one of two gloo ranks running the SAME
+# config rank-sharded (one model column = one tier shard each);
+# "overlap" A/Bs the compute-overlapped entries exchange on a 2x2
+# mesh.  Each prints one `FLEETBENCH {json}` line.
+_FLEET_BENCH_WORKER = r"""
+import json, os, sys, time
+
+mode = sys.argv[1]          # "fleet" | "global" | "overlap"
+tmpdir = sys.argv[2]
+threads = int(sys.argv[3])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+if mode == "fleet":
+    # CPU cross-process collectives need the gloo transport.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=sys.argv[4],
+        num_processes=2,
+        process_id=int(sys.argv[5]),
+    )
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.train.loop import Trainer
+
+VOCAB = 1 << 16
+files = sorted(
+    os.path.join(tmpdir, f) for f in os.listdir(tmpdir)
+    if f.endswith(".libsvm")
+)
+
+
+def run(tag, **kw):
+    base = dict(
+        vocabulary_size=VOCAB, factor_num=8, max_features=16,
+        batch_size=1024, learning_rate=0.05, train_files=files,
+        model_file=os.path.join(tmpdir, "model_" + tag),
+        log_steps=0, thread_num=threads, queue_size=threads,
+        epoch_num=2, steps_per_dispatch=2, save_steps=0,
+    )
+    base.update(kw)
+    t = Trainer(FmConfig(**base))
+    t.save = lambda stepno: None  # perf section, not a checkpoint test
+    t0 = time.perf_counter()
+    r = t.train()
+    wall = time.perf_counter() - t0
+    exch = t.telemetry.timer("train.exchange").snapshot().get(
+        "total_s", 0.0
+    )
+    return {
+        "examples_per_sec": r["train"]["examples_per_sec"],
+        "wall_s": round(wall, 3),
+        "exchange_frac": round(exch / wall, 6) if wall > 0 else 0.0,
+        "device_bytes": int(t._state_bytes_est),
+        "tiered": r["train"].get("tiered"),
+        "overlap_active": bool(t._overlap_active),
+    }
+
+
+if mode == "fleet":
+    rank = int(sys.argv[5])
+    port0, port1 = int(sys.argv[6]), int(sys.argv[7])
+    out = run(
+        "fleet%d" % rank, mesh_data=1, mesh_model=2,
+        table_tiering="on", hot_rows=1 << 15,
+        tiered_partition="shards",
+        status_port=port0 if rank == 0 else port1,
+        train_fleet_scrape="127.0.0.1:%d,127.0.0.1:%d" % (port0, port1),
+        heartbeat_secs=0.5,
+    )
+    out["rank"] = rank
+elif mode == "global":
+    out = run(
+        "global", mesh_data=1, mesh_model=2,
+        table_tiering="on", hot_rows=1 << 15,
+        tiered_partition="global",
+    )
+else:  # overlap: off/on A/B, same process, same files, same mesh
+    port_off, port_on = int(sys.argv[4]), int(sys.argv[5])
+    kw = dict(
+        mesh_data=2, mesh_model=2, sparse_apply="tile",
+        sparse_exchange="entries", heartbeat_secs=0.5,
+    )
+    out = {
+        "off": run("ov_off", sparse_exchange_overlap="off",
+                   status_port=port_off,
+                   train_fleet_scrape="127.0.0.1:%d" % port_off, **kw),
+        "on": run("ov_on", sparse_exchange_overlap="on",
+                  status_port=port_on,
+                  train_fleet_scrape="127.0.0.1:%d" % port_on, **kw),
+    }
+print("FLEETBENCH " + json.dumps(out), flush=True)
+"""
+
+
+def _bench_fleet_train(workers: int) -> dict:
+    """Fleet-training section (ISSUE 19): the rank-sharded tiered table
+    and the overlapped sparse exchange, measured as real processes.
+
+    Three sub-runs over one generated dataset (V=2^16 Zipf, hot=2^15):
+
+      * a single-process host-global tiered baseline on the (1x2) mesh
+        — the pre-sharding memory/throughput reference;
+      * a 2-rank gloo fleet running the SAME recipe rank-sharded: each
+        rank's hot-table+optimizer device bytes and cold-store bytes
+        must land at ~1/R of the baseline's (the tentpole's memory
+        claim, asserted here as shard_bytes_frac_ok);
+      * an overlap A/B on a 2x2 mesh with the entries exchange: the
+        train.exchange probe's synchronous window fraction with the
+        overlap off vs on — on must read strictly lower (the merge is
+        hidden behind rank-local apply; parity is pinned bitwise in
+        tests/test_tiered_fleet.py, this section measures the win).
+    """
+    import socket
+
+    out: dict = {"completed": False}
+    tmpdir = tempfile.mkdtemp(prefix="fast_tffm_fleet_")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rng = np.random.default_rng(13)
+        _gen_libsvm_files(tmpdir, rng, 2, 8192, 16, 1 << 16)
+        script = os.path.join(tmpdir, "fleet_bench_worker.py")
+        with open(script, "w") as f:
+            f.write(_FLEET_BENCH_WORKER)
+        threads = max(2, workers // 2)
+
+        def spawn(argv, devices):
+            env = dict(
+                os.environ,
+                PALLAS_AXON_POOL_IPS="",
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS=(
+                    "--xla_force_host_platform_device_count=%d"
+                    % devices
+                ),
+                PYTHONPATH=repo + os.pathsep + os.environ.get(
+                    "PYTHONPATH", ""
+                ),
+            )
+            return subprocess.Popen(
+                [sys.executable, script] + [str(a) for a in argv],
+                env=env, cwd=repo, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+
+        def harvest(proc, tag, timeout=600):
+            o, e = proc.communicate(timeout=timeout)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"{tag} worker rc={proc.returncode}: {e[-1500:]}"
+                )
+            for line in o.splitlines():
+                if line.startswith("FLEETBENCH "):
+                    return json.loads(line[len("FLEETBENCH "):])
+            raise RuntimeError(f"{tag} worker printed no result line")
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        glob_res = harvest(
+            spawn(["global", tmpdir, threads], 2), "global"
+        )
+
+        coord = f"127.0.0.1:{free_port()}"
+        p0, p1 = free_port(), free_port()
+        procs = [
+            spawn(["fleet", tmpdir, threads, coord, r, p0, p1], 1)
+            for r in range(2)
+        ]
+        ranks = []
+        try:
+            for i, p in enumerate(procs):
+                ranks.append(harvest(p, f"fleet rank {i}"))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+
+        ov = harvest(
+            spawn(["overlap", tmpdir, threads, free_port(),
+                   free_port()], 4),
+            "overlap",
+        )
+
+        rank_bytes = max(r["device_bytes"] for r in ranks)
+        glob_bytes = max(1, glob_res["device_bytes"])
+        rank_cold = max(
+            (r.get("tiered") or {}).get("cold_store_bytes", 0)
+            for r in ranks
+        )
+        glob_cold = (glob_res.get("tiered") or {}).get(
+            "cold_store_bytes", 0
+        )
+        shard_frac = rank_bytes / glob_bytes
+        out.update({
+            "completed": True,
+            "tier_shards": 2,
+            "vocab_log2": 16,
+            "hot_rows_log2": 15,
+            "sharded_examples_per_sec": round(
+                min(r["examples_per_sec"] for r in ranks), 1
+            ),
+            "global_examples_per_sec": round(
+                glob_res["examples_per_sec"], 1
+            ),
+            "fleet_exchange_frac": round(
+                max(r["exchange_frac"] for r in ranks), 6
+            ),
+            "rank_device_bytes": rank_bytes,
+            "global_device_bytes": glob_res["device_bytes"],
+            "shard_bytes_frac": round(shard_frac, 4),
+            # The ~1/R acceptance at R=2: each rank's table+optimizer
+            # device bytes must sit near half the host-global run's
+            # (w0/scalars stay replicated, hence the band, not 0.5).
+            "shard_bytes_frac_ok": bool(0.3 < shard_frac < 0.7),
+            "rank_cold_store_bytes": rank_cold,
+            "global_cold_store_bytes": glob_cold,
+            "cold_bytes_frac": round(
+                rank_cold / max(1, glob_cold), 4
+            ),
+            "rank_owned_shards": [
+                (r.get("tiered") or {}).get("owned_shards") for r in ranks
+            ],
+            "exchange_frac_off": ov["off"]["exchange_frac"],
+            "exchange_overlap_frac": ov["on"]["exchange_frac"],
+            "overlap_active": bool(ov["on"]["overlap_active"]),
+            # The overlap acceptance: the synchronous exchange window
+            # must shrink when the merge rides behind rank-local apply.
+            "overlap_hides_exchange": bool(
+                ov["on"]["exchange_frac"] < ov["off"]["exchange_frac"]
+            ),
+            "overlap_examples_per_sec_off": round(
+                ov["off"]["examples_per_sec"], 1
+            ),
+            "overlap_examples_per_sec_on": round(
+                ov["on"]["examples_per_sec"], 1
+            ),
+        })
+        if not out["shard_bytes_frac_ok"]:
+            out["error"] = (
+                f"per-rank device bytes {rank_bytes} not ~1/2 of "
+                f"host-global {glob_res['device_bytes']}"
+            )
+    except Exception as e:  # noqa: BLE001 - report, never sink the bench
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return out
+
+
 def _bench_quant(workers: int) -> dict:
     """Quantized-table section: the BENCH tiered config (V=2^28 Zipf,
     hot_rows=2^20) trained with each cold_dtype — step rate + real
@@ -1661,6 +1916,33 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 - preflight must not sink bench
         print(f"lint preflight failed: {e}", file=sys.stderr)
 
+    # Preflight: bench-trajectory trend (the same adjacent-step rule
+    # `tools/report.py --timeline` prints) over any committed
+    # BENCH_r*.json stack next to this script.  timeline_regressions
+    # is the count of keys whose trend already crossed the threshold —
+    # a numeric top-level key, so --compare gates a NEW one appearing
+    # (direction: low) without anyone remembering to run --timeline.
+    timeline_regs = None
+    timeline_reg_keys = None
+    try:
+        import glob as glob_mod
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        hist = sorted(glob_mod.glob(os.path.join(repo, "BENCH_r*.json")))
+        if len(hist) >= 2:
+            if repo not in sys.path:
+                sys.path.insert(0, repo)
+            from tools import report as report_mod
+
+            tr = report_mod.timeline_regressions(hist)
+            timeline_regs = len(tr["regressions"])
+            if tr["regressions"]:
+                timeline_reg_keys = dict(
+                    sorted(tr["regressions"].items())[:8]
+                )
+    except Exception as e:  # noqa: BLE001 - preflight must not sink bench
+        print(f"timeline preflight failed: {e}", file=sys.stderr)
+
     watchdog_note = None
     if not os.environ.get("BENCH_CHILD") and not os.environ.get(
         "BENCH_FORCE_CPU"
@@ -1694,6 +1976,7 @@ def main() -> int:
     step_rate_k1, e2e_rate_k1 = 0.0, 0.0
     s_samples, s1_samples, e_samples = [], [], []
     tiered_section = None
+    fleet_section = None
     serve_section = None
     serve_router_section = None
     quant_section = None
@@ -2156,6 +2439,10 @@ def main() -> int:
             # Quantized-table section: the same tiered config trained
             # under each cold_dtype (bytes per row vs step rate).
             quant_section = _with_rss_delta(_bench_quant, workers)
+            # Fleet-training section: rank-sharded tiering (2 gloo
+            # ranks vs the host-global baseline — the ~1/R memory
+            # claim) and the overlapped-exchange A/B (ISSUE 19).
+            fleet_section = _with_rss_delta(_bench_fleet_train, workers)
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         e2e_err = f"bench failed: {type(e).__name__}: {e}"
 
@@ -2387,6 +2674,40 @@ def main() -> int:
                     "serve_http_threads"):
             if key in serve_section:
                 result[key] = serve_section[key]
+    if fleet_section is not None:
+        result["fleet_train"] = fleet_section
+        if fleet_section.get("completed"):
+            # Top-level copies of the gated axes (--compare flattens
+            # numeric top-level keys only): the exchange windows must
+            # not grow back, the per-rank byte fractions must hold the
+            # ~1/R sharding claim, the sharded step rate is a plain
+            # throughput axis.
+            result["fleet_exchange_frac"] = (
+                fleet_section["exchange_frac_off"]
+            )
+            result["fleet_exchange_overlap_frac"] = (
+                fleet_section["exchange_overlap_frac"]
+            )
+            result["fleet_shard_bytes_frac"] = (
+                fleet_section["shard_bytes_frac"]
+            )
+            result["fleet_cold_bytes_frac"] = (
+                fleet_section["cold_bytes_frac"]
+            )
+            result["fleet_sharded_examples_per_sec"] = (
+                fleet_section["sharded_examples_per_sec"]
+            )
+            result["fleet_global_examples_per_sec"] = (
+                fleet_section["global_examples_per_sec"]
+            )
+            result["fleet_tier_shards"] = fleet_section["tier_shards"]
+    if timeline_regs is not None:
+        # Bench preflight (--timeline over BENCH_r*.json): how many
+        # keys' trends already crossed their threshold, plus the first
+        # few attributions.  0 -> N flags in --compare (direction low).
+        result["timeline_regressions"] = timeline_regs
+        if timeline_reg_keys:
+            result["timeline_regression_keys"] = timeline_reg_keys
     if tier1_audit is not None:
         result["tier1_audit"] = tier1_audit
     if lint_findings_new is not None:

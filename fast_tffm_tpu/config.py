@@ -462,6 +462,33 @@ class FmConfig:
     # property the reference's PS push had); "auto" picks whichever moves
     # fewer bytes for the static shapes.
     sparse_exchange: str = "auto"
+    # Double-buffer the entries exchange's ID PLANE one super-batch
+    # step ahead (ops/sparse_apply.entries_prefetch): the deduped
+    # touched-row streams for scan step k+1 are computed and
+    # all-gathered while step k's local apply runs, so only the
+    # payload gather stays on the critical path — compute-overlapped
+    # cross-rank merge, bitwise-identical parameters (the id plane is a
+    # pure function of the batch ids; pinned by test).  "auto" (default)
+    # overlaps whenever the GSPMD sharded entries exchange is actually
+    # active (multi-shard data axis, entries mode, fused scan); "on"
+    # REQUIRES that path and refuses loudly otherwise (the
+    # silently-inert-knob discipline); "off" never overlaps — the
+    # diagnostic A/B mode, under which the train.exchange probe blocks
+    # synchronously and so measures the UN-overlapped exchange window
+    # (see OBSERVABILITY.md).
+    sparse_exchange_overlap: str = "auto"  # auto | on | off
+    # How tiered-table ownership is partitioned across the mesh
+    # (train.tiered_fleet): "global" is the classic single-process
+    # host-global hot-slot map; "shards" splits id range + hot slots +
+    # cold stores + write-back ledger by MODEL column, each rank
+    # planning/migrating/checkpointing ONLY the shards whose columns it
+    # owns (~1/R host bytes and migration traffic per rank — the
+    # multi-process tiering mode).  "auto" picks shards when
+    # process_count > 1, else global.  Sharded tiering requires every
+    # model column to live on one process (canonically mesh_data=1,
+    # mesh_model=R), identical global batches on every rank, and
+    # vocabulary/hot_rows divisible by mesh_model.
+    tiered_partition: str = "auto"  # auto | global | shards
 
     def __post_init__(self) -> None:
         if self.vocabulary_size <= 0:
@@ -759,6 +786,35 @@ class FmConfig:
             raise ValueError(
                 f"quant_chunk must be >= 0, got {self.quant_chunk}"
             )
+        if self.sparse_exchange_overlap not in ("auto", "on", "off"):
+            raise ValueError(
+                "unknown sparse_exchange_overlap "
+                f"{self.sparse_exchange_overlap!r}"
+            )
+        if self.sparse_exchange_overlap == "on" \
+                and self.sparse_exchange == "dense":
+            # Inert-knob discipline: the overlap double-buffers the
+            # ENTRIES exchange's id plane; under the dense psum there
+            # is no id plane to prefetch.  (The remaining "on"
+            # requirements — sharded apply, multi-shard data axis —
+            # need the mesh and are enforced at Trainer build.)
+            raise ValueError(
+                "sparse_exchange_overlap=on requires the entries "
+                "exchange; sparse_exchange=dense has no id plane to "
+                "overlap"
+            )
+        if self.tiered_partition not in ("auto", "global", "shards"):
+            raise ValueError(
+                f"unknown tiered_partition {self.tiered_partition!r}"
+            )
+        if self.tiered_partition != "auto" and self.table_tiering != "on":
+            # tiered_partition names how the tiered table's ownership
+            # splits across ranks; without tiering there is nothing to
+            # partition (silently-inert-knob discipline).
+            raise ValueError(
+                "tiered_partition requires table_tiering=on (it "
+                "partitions the tiered table's hot-slot ownership)"
+            )
         if self.cold_dtype != "fp32" and self.table_tiering != "on":
             # The silently-inert-knob hazard (same discipline as
             # alert_rules-without-heartbeat): cold_dtype names the
@@ -919,6 +975,8 @@ _KEYMAP = {
     "host_sort": ("host_sort", _parse_bool),
     "l2_mode": ("l2_mode", str),
     "sparse_exchange": ("sparse_exchange", str),
+    "sparse_exchange_overlap": ("sparse_exchange_overlap", str),
+    "tiered_partition": ("tiered_partition", str),
     "steps_per_dispatch": ("steps_per_dispatch", int),
     "prefetch_super_batches": ("prefetch_super_batches", int),
     "parse_processes": ("parse_processes", int),

@@ -1631,11 +1631,19 @@ class _StagingPool:
 
     @staticmethod
     def _probe_alias(dev, bufs: libsvm.Batch) -> bool:
-        """True when any device-array leaf of ``dev`` shares memory with
-        a staging buffer — the zero-copy device_put case where reuse
-        would corrupt in-flight data.  Only probed on the CPU backend
-        (accelerator puts always copy across the host/device boundary);
-        errs toward True (no reuse) on any surprise."""
+        """True when any leaf of ``dev`` may share memory with a staging
+        buffer — the zero-copy device_put case where reuse would corrupt
+        in-flight data.  Only probed on the CPU backend (accelerator puts
+        always copy across the host/device boundary); errs toward True
+        (no reuse) on any surprise.
+
+        Multi-device leaves are unconditionally treated as aliasing: the
+        CPU client may zero-copy individual shards at the PJRT-buffer
+        level, but ``np.asarray`` on a sharded array assembles a fresh
+        copy, so ``np.shares_memory`` cannot observe the alias from
+        Python.  Recycling under a (1, N) mesh provably rewrites queued
+        super-batches (rare bimodal loss flips under host load), so the
+        probe refuses reuse rather than trusting an unverifiable copy."""
         import sys
 
         jax = sys.modules.get("jax")
@@ -1648,7 +1656,14 @@ class _StagingPool:
             if bufs.sort_meta is not None:
                 host.extend(bufs.sort_meta)
             for leaf in jax.tree_util.tree_leaves(dev):
-                if isinstance(leaf, jax.Array):
+                if isinstance(leaf, np.ndarray):
+                    # A shipped object retaining host numpy (e.g. host
+                    # sort_meta) references the staging buffers directly.
+                    if any(np.shares_memory(leaf, h) for h in host):
+                        return True
+                elif isinstance(leaf, jax.Array):
+                    if len(leaf.sharding.device_set) > 1:
+                        return True
                     a = np.asarray(leaf)
                     if any(np.shares_memory(a, h) for h in host):
                         return True
@@ -1662,10 +1677,11 @@ class _StagingPool:
             self._alias_mode = self._probe_alias(dev, bufs)
             if self._alias_mode:
                 log.info(
-                    "staging-buffer reuse disabled: device_put aliases "
-                    "host memory on this backend (single-device CPU "
-                    "zero-copy), so recycling would corrupt in-flight "
-                    "super-batches; stacking allocates fresh buffers"
+                    "staging-buffer reuse disabled: device_put may alias "
+                    "host memory on this backend (CPU zero-copy; "
+                    "unverifiable for sharded arrays), so recycling "
+                    "would corrupt in-flight super-batches; stacking "
+                    "allocates fresh buffers"
                 )
         if self._alias_mode:
             # The device array owns this memory now — it left the pool.

@@ -206,6 +206,14 @@ RANK_SERIES = (
     ("wait_p99_ms", "tffm_train_rank_wait_p99_ms", "gauge"),
     ("exchange_frac", "tffm_train_rank_exchange_frac", "gauge"),
     ("scrape_age_s", "tffm_train_rank_scrape_age_s", "gauge"),
+    # Rank-sharded tiering (ISSUE 19): each rank's share of the tier
+    # partition — its cold-store bytes and how many shards it owns
+    # (fleet-wide the owned counts must sum to num_shards; a hole
+    # means some id range has no owner writing it back).
+    ("tiered_cold_store_bytes", "tffm_train_rank_tiered_cold_bytes",
+     "gauge"),
+    ("tiered_owned_shards", "tffm_train_rank_tiered_owned_shards",
+     "gauge"),
 )
 
 _TIMER_ROWS = (
@@ -228,6 +236,16 @@ def _rank_row(target: str, index: int, t: float, rec: dict,
         val = rec.get(key)
         if isinstance(val, (int, float)):
             row[key] = val
+    tiered = rec.get("tiered")
+    if isinstance(tiered, dict):
+        # Rank-sharded tiering: the per-rank partition share (sharded
+        # snapshots carry num_shards/owned_shards; host-global tiered
+        # ranks only the byte/row figures).
+        for key in ("cold_store_bytes", "resident_rows",
+                    "num_shards", "owned_shards"):
+            val = tiered.get(key)
+            if isinstance(val, (int, float)):
+                row[f"tiered_{key}"] = val
     timers = (rec.get("stages") or {}).get("timers") or {}
     for short, name in _TIMER_ROWS:
         snap = timers.get(name) or {}
@@ -408,6 +426,28 @@ class TrainFleet:
             # as the skew PSI max-merge): one rank stuck at the
             # barrier is the signal, and a mean would dilute it.
             out["exchange_frac"] = round(max(fracs), 6)
+        cold = [
+            r["tiered_cold_store_bytes"] for r in rows
+            if isinstance(r.get("tiered_cold_store_bytes"), (int, float))
+        ]
+        if cold:
+            # Rank-sharded tiering: the fleet's logical cold store is
+            # the SUM of the rank shards (each id range lives on
+            # exactly one rank); owned summed against num_shards is
+            # the partition-coverage check — fewer means an id range
+            # has no owner flushing its write-backs.
+            out["tiered_cold_store_bytes"] = int(sum(cold))
+            owned = [
+                r["tiered_owned_shards"] for r in rows
+                if isinstance(r.get("tiered_owned_shards"), (int, float))
+            ]
+            shards = [
+                r["tiered_num_shards"] for r in rows
+                if isinstance(r.get("tiered_num_shards"), (int, float))
+            ]
+            if owned and shards:
+                out["tiered_owned_shards"] = int(sum(owned))
+                out["tiered_num_shards"] = int(max(shards))
         return out
 
     def metrics_lines(self, now: Optional[float] = None) -> str:

@@ -641,7 +641,7 @@ def k2_apply(update, tile_start, u, tables, compact=None):
 
 
 def entries_exchange(lids, g_rows, *, vocab_local, data_axis,
-                     data_shards):
+                     data_shards, rows_all=None):
     """The ONE copy of the entries-exchange protocol (shard_map body):
     dedupe LOCAL-coordinate occurrences (off-shard ids pre-mapped to the
     sentinel ``vocab_local``, their payloads zeroed), all-gather the
@@ -654,6 +654,15 @@ def entries_exchange(lids, g_rows, *, vocab_local, data_axis,
     exchange, and the single-device dedup already produces the K2
     stream — the gather + second sort + second K1 pass would only
     re-derive it.
+
+    ``rows_all`` (optional) is the pre-gathered ID PLANE: the
+    concatenated per-data-shard row streams this call would otherwise
+    all-gather itself.  The id plane is a pure function of the batch
+    ids (dedup order never looks at payloads), so a caller that knows
+    the NEXT super-batch's ids can compute and gather it one scan step
+    early (:func:`make_entries_prefetch`) and overlap that collective
+    with the previous step's compute — only the payload gather stays
+    on the critical path.  Bitwise-identical results by construction.
     """
     if data_shards == 1:
         return _dedup_and_starts(lids, g_rows, vocab_local)
@@ -661,9 +670,56 @@ def entries_exchange(lids, g_rows, *, vocab_local, data_axis,
     rows_e, pay_e, _ = unique_entries(
         lids, g_rows, vocab=vocab_local, cap=cap
     )
-    rows_all = jax.lax.all_gather(rows_e, data_axis, axis=0, tiled=True)
+    if rows_all is None:
+        rows_all = jax.lax.all_gather(
+            rows_e, data_axis, axis=0, tiled=True
+        )
     pay_all = jax.lax.all_gather(pay_e, data_axis, axis=0, tiled=True)
     return merge_entries(rows_all, pay_all, vocab=vocab_local)
+
+
+def make_entries_prefetch(mesh, data_axis, model_axis, vocab):
+    """Build the id-plane prefetch for the overlapped entries exchange.
+
+    Returns ``prefetch(ids) -> rows_all``: a shard_map program that runs
+    the per-device id dedup of :func:`unique_entries` (payloads zeroed —
+    the row stream is payload-independent) and all-gathers the streams
+    over the data axis, producing the ``rows_all`` operand
+    :func:`entries_exchange` accepts.  The output is a ``P(model)``
+    global array ([model_shards * data_shards * cap]): every data
+    replica of a model column computes the identical gathered stream,
+    and the scan carries it to the NEXT step's apply — where it enters
+    with an in_spec of ``P(model)``, landing each device exactly the
+    block it would have gathered itself.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from fast_tffm_tpu.platform import shard_map
+
+    model_shards = mesh.shape[model_axis]
+    vocab_local = vocab // model_shards
+
+    def local(ids_l):
+        m = jax.lax.axis_index(model_axis)
+        row_lo = m * vocab_local
+        in_range = (ids_l >= row_lo) & (ids_l < row_lo + vocab_local)
+        lids = jnp.where(
+            in_range, ids_l - row_lo, vocab_local
+        ).astype(jnp.int32)
+        cap = entries_cap(lids.shape[0], vocab_local)
+        zeros = jnp.zeros((lids.shape[0], 1), jnp.float32)
+        rows_e, _, _ = unique_entries(
+            lids, zeros, vocab=vocab_local, cap=cap
+        )
+        return jax.lax.all_gather(rows_e, data_axis, axis=0, tiled=True)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(data_axis),
+        out_specs=P(model_axis),
+        check_vma=False,
+    )
 
 
 # ------------------------------------------------------------ orchestration
@@ -888,7 +944,7 @@ def supports_tile_sharded(vocab: int, optimizer: str, model_shards: int) -> bool
 
 
 def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
-                  g_rows, vocab, exchange="dense"):
+                  g_rows, vocab, exchange="dense", rows_all=None):
     """shard_map wrapper: per-device K1 dedup, then either a dense
     per-shard delta psum over the data axis (``exchange="dense"``) or a
     batch-proportional all-gather of the touched-entry streams
@@ -899,14 +955,29 @@ def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
     collective pattern (O(vocab) bytes); entries mode keeps the PS
     design's IndexedSlices property — bytes scale with the batch,
     independent of vocab.
+
+    ``rows_all`` is the prefetched id plane for the overlapped entries
+    exchange (see :func:`entries_exchange` / :func:`make_entries_prefetch`)
+    — only legal with ``exchange="entries"`` and a multi-shard data axis.
     """
     from jax.sharding import PartitionSpec as P
 
     model_shards = mesh.shape[model_axis]
     vocab_local = vocab // model_shards
     n_tables = len(tables)
+    if rows_all is not None and (
+        exchange != "entries" or mesh.shape[data_axis] == 1
+    ):
+        raise ValueError(
+            "a prefetched id plane (rows_all) only applies to the "
+            "entries exchange over a multi-shard data axis"
+        )
 
-    def local(ids_l, g_l, *tables_l):
+    def local(ids_l, g_l, *rest):
+        if rows_all is not None:
+            rows_in, tables_l = rest[0], rest[1:]
+        else:
+            rows_in, tables_l = None, rest
         m = jax.lax.axis_index(model_axis)
         row_lo = m * vocab_local
         d = g_l.shape[1]
@@ -919,6 +990,7 @@ def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
             u2, ts2 = entries_exchange(
                 lids, g_masked, vocab_local=vocab_local,
                 data_axis=data_axis, data_shards=mesh.shape[data_axis],
+                rows_all=rows_in,
             )
             # k2_apply expects update -> tuple; the single-table (sgd)
             # wrapper returns a bare array.
@@ -937,41 +1009,45 @@ def _sharded_call(update_fn, mesh, data_axis, model_axis, tables, ids,
 
     from fast_tffm_tpu.platform import shard_map
 
+    extra = () if rows_all is None else (rows_all,)
+    extra_specs = () if rows_all is None else (P(model_axis),)
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(data_axis), P(data_axis, None))
+        in_specs=(P(data_axis), P(data_axis, None)) + extra_specs
         + (P(model_axis, None),) * n_tables,
         out_specs=(P(model_axis, None),) * n_tables
         if n_tables > 1 else P(model_axis, None),
         check_vma=False,  # pallas_call outputs carry no vma annotations
-    )(ids, g_rows, *tables)
+    )(ids, g_rows, *extra, *tables)
 
 
 def adagrad_apply_sharded(table, acc, ids, g_rows, *, lr, eps, mesh,
-                          data_axis, model_axis, exchange="dense"):
+                          data_axis, model_axis, exchange="dense",
+                          rows_all=None):
     def update(g1, g2, table_l, acc_l):
         return adagrad_update(g1, g2, table_l, acc_l, lr=lr, eps=eps)
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table, acc), ids, g_rows,
-        table.shape[0], exchange=exchange,
+        table.shape[0], exchange=exchange, rows_all=rows_all,
     )
 
 
 def sgd_apply_sharded(table, ids, g_rows, *, lr, mesh, data_axis,
-                      model_axis, exchange="dense"):
+                      model_axis, exchange="dense", rows_all=None):
     def update(g1, g2, table_l):
         return sgd_update(g1, g2, table_l, lr=lr)[0]
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table,), ids, g_rows,
-        table.shape[0], exchange=exchange,
+        table.shape[0], exchange=exchange, rows_all=rows_all,
     )
 
 
 def ftrl_apply_sharded(table, z, n, ids, g_rows, *, lr, l1, l2, beta, mesh,
-                       data_axis, model_axis, exchange="dense"):
+                       data_axis, model_axis, exchange="dense",
+                       rows_all=None):
     def update(g1, g2, table_l, z_l, n_l):
         return ftrl_update(
             g1, g2, table_l, z_l, n_l, lr=lr, l1=l1, l2=l2, beta=beta
@@ -979,5 +1055,5 @@ def ftrl_apply_sharded(table, z, n, ids, g_rows, *, lr, l1, l2, beta, mesh,
 
     return _sharded_call(
         update, mesh, data_axis, model_axis, (table, z, n), ids, g_rows,
-        table.shape[0], exchange=exchange,
+        table.shape[0], exchange=exchange, rows_all=rows_all,
     )

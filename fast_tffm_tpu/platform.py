@@ -96,6 +96,36 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
     )
 
 
+_rng_pinned = False
+
+
+def ensure_sharding_invariant_rng() -> None:
+    """Make jax.random draws invariant to the output sharding.
+
+    jax 0.4.37 defaults ``jax_threefry_partitionable=False``; under that
+    mode GSPMD partitions the threefry counter computation of a jitted
+    draw in a value-CHANGING way on mixed meshes — a ``(data=4, model=2)``
+    mesh initialized a different table than the 1x1 reference while the
+    pure-axis 8x1/1x8 meshes agreed (tools/parity_probe.py localized the
+    `[4-2]` red to INIT, before any step).  Partitionable threefry is
+    sharding-invariant by construction (and upstream's forward default),
+    so a table initialized under ANY mesh — including an unjitted host
+    draw for the tiered cold store — is element-wise identical.
+
+    The partitionable stream differs from the legacy one, so fresh inits
+    change values once per upgrade; checkpoints store values, not keys,
+    and are unaffected.  Called at ``models.fm`` import (the module that
+    defines ``init_params``), so every init path inherits it.
+    """
+    global _rng_pinned
+    if _rng_pinned:
+        return
+    import jax
+
+    jax.config.update("jax_threefry_partitionable", True)
+    _rng_pinned = True
+
+
 def ffm_compute_dtype(compute_dtype):
     """The dtype FFM's einsum operands may use on the current target.
 

@@ -147,8 +147,32 @@ def _tiered_path(model_file: str) -> str:
     return os.path.join(os.path.abspath(model_file), "tiered.npz")
 
 
+def _tiered_shard_path(model_file: str, index: int, count: int) -> str:
+    return os.path.join(
+        os.path.abspath(model_file), f"tiered.shard{index}of{count}.npz"
+    )
+
+
+def _tiered_shard_files(model_file: str) -> list:
+    """[(index, count, path)] of every per-shard overlay file present."""
+    import glob as _glob
+    import re
+
+    out = []
+    pat = re.compile(r"tiered\.shard(\d+)of(\d+)\.npz$")
+    for p in sorted(_glob.glob(
+        os.path.join(os.path.abspath(model_file), "tiered.shard*.npz")
+    )):
+        m = pat.search(p)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)), p))
+    return out
+
+
 def exists_tiered(model_file: str) -> bool:
-    return os.path.isfile(_tiered_path(model_file))
+    return os.path.isfile(_tiered_path(model_file)) or bool(
+        _tiered_shard_files(model_file)
+    )
 
 
 def save_tiered(
@@ -207,11 +231,89 @@ def save_tiered(
     log.info("saved tiered overlay checkpoint step=%d to %s", step, path)
 
 
-def restore_tiered(model_file: str) -> Optional[tuple]:
-    """(step, scalars, stores) from a tiered overlay, or None."""
-    path = _tiered_path(model_file)
-    if not os.path.isfile(path):
-        return None
+def save_tiered_shards(
+    model_file: str,
+    step: int,
+    scalars: dict,
+    overlays_by_shard: dict,
+    num_shards: int,
+    data_state: Optional[dict] = None,
+    manifest_extra: Optional[dict] = None,
+    primary: bool = True,
+    barrier=None,
+) -> None:
+    """Rank-sharded overlay checkpoint (train.tiered_fleet): each rank
+    writes one ``tiered.shard{s}of{S}.npz`` per OWNED shard, ids in
+    GLOBAL space, same per-store payload schema as ``save_tiered`` —
+    the union of the S files IS the checkpoint, and because every row
+    is keyed by global id the union re-partitions across any new shard
+    count (elastic resume).  Every file carries step+scalars (they are
+    replicated state; redundancy keeps any single file self-describing).
+
+    Multi-rank protocol: all ranks write their files, ``barrier()``
+    (if given — ``multihost_utils.sync_global_devices`` in the fleet)
+    joins them, then the PRIMARY rank alone removes whatever the new
+    files supersede (stale shard sets from a different S, a plain
+    tiered.npz, the dense dirs, quant.npz), writes ``data_state`` and
+    publishes the manifest — so a published step always names a
+    complete shard set.
+    """
+    os.makedirs(os.path.abspath(model_file), exist_ok=True)
+    wrote = set()
+    for s, stores in overlays_by_shard.items():
+        payload: dict = {
+            "scalar/step": np.int64(step),
+            "meta/stores": np.array(json.dumps(sorted(stores))),
+            "meta/shard": np.array([int(s), int(num_shards)], np.int64),
+        }
+        for name, val in scalars.items():
+            payload[f"scalar/{name}"] = np.asarray(val)
+        for name, store in stores.items():
+            payload[f"{name}/ids"] = store["ids"]
+            payload[f"{name}/rows"] = store["rows"]
+            payload[f"{name}/descriptor"] = np.array(
+                json.dumps(store.get("descriptor", {}), sort_keys=True)
+            )
+        path = _tiered_shard_path(model_file, s, num_shards)
+        tmp = path + ".tmp.npz"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+        wrote.add(path)
+    if barrier is not None:
+        barrier()
+    if not primary:
+        return
+    keep = {
+        _tiered_shard_path(model_file, s, num_shards)
+        for s in range(num_shards)
+    }
+    for _, _, p in _tiered_shard_files(model_file):
+        if p not in keep:
+            os.remove(p)
+    try:
+        os.remove(_tiered_path(model_file))
+    except FileNotFoundError:
+        pass
+    for stale in (_params_dir(model_file), _opt_dir(model_file)):
+        if os.path.isdir(stale):
+            import shutil
+
+            shutil.rmtree(stale)
+    clear_quant(model_file)
+    if data_state is not None:
+        dtmp = _data_state_path(model_file) + ".tmp"
+        with open(dtmp, "w") as f:
+            json.dump(data_state, f)
+        os.replace(dtmp, _data_state_path(model_file))
+    _publish_manifest(model_file, step, "tiered", extra=manifest_extra)
+    log.info(
+        "saved tiered shard checkpoint step=%d (%d/%d shards this rank) "
+        "to %s", step, len(overlays_by_shard), num_shards, model_file,
+    )
+
+
+def _read_tiered_file(path: str) -> tuple:
     with np.load(path, allow_pickle=False) as z:
         names = json.loads(str(z["meta/stores"]))
         step = int(z["scalar/step"])
@@ -230,6 +332,70 @@ def restore_tiered(model_file: str) -> Optional[tuple]:
     return step, scalars, stores
 
 
+def restore_tiered(model_file: str) -> Optional[tuple]:
+    """(step, scalars, stores) from a tiered overlay, or None.
+
+    Reads BOTH formats: the single-file overlay (``tiered.npz``) and a
+    rank-sharded shard set, whose per-store payloads are concatenated
+    into one global-id overlay — so every consumer (host-global restore,
+    elastic re-sharding at any R', the serve OverlayScorer) sees one
+    format.  An INCOMPLETE or mixed shard set refuses loudly: silently
+    restoring a partial table would train on re-initialized rows.
+    """
+    path = _tiered_path(model_file)
+    if os.path.isfile(path):
+        return _read_tiered_file(path)
+    shard_files = _tiered_shard_files(model_file)
+    if not shard_files:
+        return None
+    counts = {c for _, c, _ in shard_files}
+    if len(counts) != 1:
+        raise ValueError(
+            f"tiered shard checkpoint in {model_file} mixes shard counts "
+            f"{sorted(counts)}; remove the stale set"
+        )
+    count = counts.pop()
+    have = {s for s, _, _ in shard_files}
+    missing = sorted(set(range(count)) - have)
+    if missing:
+        raise ValueError(
+            f"tiered shard checkpoint in {model_file} is missing shards "
+            f"{missing} of {count}; refusing a partial-table restore"
+        )
+    step = scalars = None
+    merged: dict = {}
+    for s, _, p in sorted(shard_files):
+        f_step, f_scalars, f_stores = _read_tiered_file(p)
+        if step is None:
+            step, scalars = f_step, f_scalars
+        elif f_step != step:
+            raise ValueError(
+                f"tiered shard files in {model_file} disagree on step "
+                f"({f_step} != {step}); the save was torn"
+            )
+        for name, payload in f_stores.items():
+            acc = merged.setdefault(
+                name, {"ids": [], "rows": [],
+                       "descriptor": payload["descriptor"]}
+            )
+            if payload["descriptor"] != acc["descriptor"]:
+                raise ValueError(
+                    f"tiered shard files disagree on store {name!r} "
+                    "descriptor; the save mixed configs"
+                )
+            acc["ids"].append(payload["ids"])
+            acc["rows"].append(payload["rows"])
+    stores = {
+        name: {
+            "ids": np.concatenate(acc["ids"]),
+            "rows": np.concatenate(acc["rows"]),
+            "descriptor": acc["descriptor"],
+        }
+        for name, acc in merged.items()
+    }
+    return step, scalars, stores
+
+
 def clear_tiered(model_file: str) -> None:
     """Remove a stale overlay after a dense-format save (the dense dirs
     are now the checkpoint; precedence must not flip back)."""
@@ -237,6 +403,11 @@ def clear_tiered(model_file: str) -> None:
         os.remove(_tiered_path(model_file))
     except FileNotFoundError:
         pass
+    for _, _, p in _tiered_shard_files(model_file):
+        try:
+            os.remove(p)
+        except FileNotFoundError:
+            pass
 
 
 # ----------------------------------------------------------------------

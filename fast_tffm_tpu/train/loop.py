@@ -47,6 +47,7 @@ from fast_tffm_tpu.parallel import mesh as mesh_lib
 from fast_tffm_tpu.train import checkpoint, metrics as metrics_lib
 from fast_tffm_tpu.train import sparse as sparse_lib
 from fast_tffm_tpu.train import tiered as tiered_lib
+from fast_tffm_tpu.train import tiered_fleet
 from fast_tffm_tpu.train.optimizers import make_optimizer
 
 log = logging.getLogger(__name__)
@@ -204,8 +205,13 @@ def make_sparse_train_step(cfg: FmConfig, mesh=None,
             f"model_shards*{sparse_lib.sparse_apply.TILE}"
         )
 
-    def step(state: TrainState, batch: Batch):
+    def step(state: TrainState, batch: Batch, rows_all=None):
         if use_shardmap:
+            if rows_all is not None:
+                raise ValueError(
+                    "prefetched exchange streams do not compose with "
+                    "lookup=shardmap"
+                )
             out = shardmap_step.sparse_step_shardmap(
                 cfg, state.params, state.opt_state, batch, mesh,
                 health=with_health,
@@ -214,7 +220,7 @@ def make_sparse_train_step(cfg: FmConfig, mesh=None,
             out = sparse_lib.sparse_step(
                 cfg, state.params, state.opt_state, batch,
                 mesh=mesh, data_axis=mesh_lib.DATA_AXIS,
-                health=with_health,
+                health=with_health, rows_all=rows_all,
             )
         params, opt_state, scores = out[0], out[1], out[2]
         ms = _metric_update(
@@ -265,7 +271,8 @@ def make_health_update(cfg: FmConfig):
 
 
 def make_scan_train_step(step_fn, health_update=None,
-                         with_scores: bool = False):
+                         with_scores: bool = False,
+                         prefetch_fn=None):
     """Wrap a (state, batch) -> state train step in ``jax.lax.scan`` over
     a stacked super-batch: ONE dispatch trains K steps with zero
     intervening Python/host round-trips (the device-resident hot loop the
@@ -297,8 +304,26 @@ def make_scan_train_step(step_fn, health_update=None,
     store, no math — the carry update is identical either way, so
     training stays bitwise-identical with the flag off or on (pinned
     by tests/test_quality.py).
+
+    ``prefetch_fn`` (sparse_exchange_overlap): ``ids[flat] ->
+    rows_all`` building the merged cross-rank entries stream for the
+    sharded sparse apply.  The stream for step i+1 is a pure function
+    of its ids — no dependency on step i's params — so the scan body
+    computes it AFTER the step that consumes the carried stream: XLA
+    schedules the i+1 all-gather concurrently with step i's rank-local
+    apply (the no-bubble overlap).  Step 0's stream is built before
+    the scan; the last body's prefetch targets a throwaway duplicate
+    of the final batch (its result is discarded with the carry).
+    Params are bitwise-identical to the non-overlapped path: the
+    stream handed to each step is exactly the one the step would have
+    computed inline (pinned by tests).
     """
     if health_update is None:
+        if prefetch_fn is not None:
+            raise ValueError(
+                "exchange-overlap prefetch requires the health-carry "
+                "scan (the trainer's only dispatch path)"
+            )
 
         def scan_step(state: TrainState, batches: Batch) -> TrainState:
             def body(carry, batch):
@@ -311,6 +336,29 @@ def make_scan_train_step(step_fn, health_update=None,
 
     def scan_health_step(state: TrainState, health: HealthState,
                          batches: Batch):
+        if prefetch_fn is not None:
+            # xs gains each step's NEXT ids (last one self-duplicated);
+            # the carried stream always matches the batch it trains.
+            next_ids = jnp.concatenate(
+                [batches.ids[1:], batches.ids[-1:]], axis=0
+            )
+            streams0 = prefetch_fn(batches.ids[0].reshape(-1))
+
+            def body(carry, xs):
+                s, h, streams = carry
+                batch, nids = xs
+                s2, aux, scores = step_fn(s, batch, streams)
+                streams2 = prefetch_fn(nids.reshape(-1))
+                carry2 = (s2, health_update(h, s2, batch, aux), streams2)
+                return carry2, (scores if with_scores else None)
+
+            (state, health, _), ys = jax.lax.scan(
+                body, (state, health, streams0), (batches, next_ids)
+            )
+            if with_scores:
+                return state, health, ys
+            return state, health
+
         def body(carry, batch):
             s, h = carry
             s2, aux, scores = step_fn(s, batch)
@@ -445,6 +493,15 @@ class Trainer:
         # properties, not table-layout properties).
         self.tiered: Optional[tiered_lib.TieredTable] = None
         self._dcfg = cfg
+        # Rank-sharded tiering: "shards" partitions the tier manager by
+        # model column (tiered_fleet.ShardedTiering) so each rank plans/
+        # migrates/checkpoints ONLY its own id range — the geometry that
+        # makes fleet-tiered training scale (~1/R host bytes + migration
+        # traffic per rank).  Resolved here so every later branch keys on
+        # one boolean.
+        self._tiering_sharded = False
+        self._tier_shards = 1
+        self._tier_owned: tuple = ()
         if cfg.table_tiering == "on":
             if not self.sparse:
                 raise ValueError(
@@ -453,19 +510,61 @@ class Trainer:
                     "a dense optimizer rewrites every row every step, so "
                     "there is no cold set to keep off-device"
                 )
-            if jax.process_count() > 1:
+            part = cfg.tiered_partition
+            if part == "auto":
+                part = "shards" if jax.process_count() > 1 else "global"
+            if part == "global" and jax.process_count() > 1:
                 raise ValueError(
-                    "table_tiering=on is single-process for now (the "
-                    "hot-slot map is host-global)"
+                    "tiered_partition=global is single-process (the "
+                    "hot-slot map is host-global); multi-process tiered "
+                    "training needs tiered_partition=shards (or auto)"
                 )
             if cfg.lookup == "shardmap":
                 raise ValueError(
                     "table_tiering=on does not compose with "
                     "lookup=shardmap yet; use lookup=auto"
                 )
-            self._dcfg = dataclasses.replace(
-                cfg, vocabulary_size=min(cfg.hot_rows, cfg.vocabulary_size)
-            )
+            hot = min(cfg.hot_rows, cfg.vocabulary_size)
+            if part == "shards":
+                # Shard == model column: the owner of a column's device
+                # rows is the one process allowed to hold its cold store.
+                owners = tiered_fleet.column_owners(self.mesh)
+                if mesh_lib.data_partition(self.mesh)[1] != 1:
+                    raise ValueError(
+                        "tiered_partition=shards requires every process "
+                        "to parse the FULL global batch (one host data "
+                        "block): the lockstep mirrors only stay equal to "
+                        "their owners when all ranks plan identical "
+                        "batches.  Use a mesh whose DATA axis does not "
+                        "span processes (canonically mesh_data=1, "
+                        "mesh_model=<process count>)."
+                    )
+                n_shards = self.mesh.shape[mesh_lib.MODEL_AXIS]
+                if cfg.vocabulary_size % n_shards or hot % n_shards:
+                    raise ValueError(
+                        f"tiered_partition=shards needs vocabulary_size "
+                        f"({cfg.vocabulary_size}) and effective hot_rows "
+                        f"({hot}) divisible by the mesh model size "
+                        f"({n_shards})"
+                    )
+                self._tiering_sharded = True
+                self._tier_shards = n_shards
+                self._tier_owned = tuple(
+                    s for s, o in enumerate(owners)
+                    if o == jax.process_index()
+                )
+                if (
+                    cfg.validation_files
+                    and len(self._tier_owned) != n_shards
+                ):
+                    raise ValueError(
+                        "validation_files with fleet-sharded tiering: "
+                        "evaluation needs every shard's cold store, but "
+                        f"this rank owns {len(self._tier_owned)} of "
+                        f"{n_shards} shards.  Evaluate from the saved "
+                        "checkpoint instead (it merges all shards)."
+                    )
+            self._dcfg = dataclasses.replace(cfg, vocabulary_size=hot)
             if cfg.hot_rows >= cfg.vocabulary_size:
                 log.info(
                     "table_tiering=on with hot_rows >= vocabulary_size: "
@@ -516,6 +615,32 @@ class Trainer:
         # size (the step's math never reads the vocab beyond table shape)
         # — and, after an autotune resolution, the measured interaction.
         dcfg = self._dcfg
+        # Compute-overlapped sparse exchange: with the sharded apply's
+        # "entries" exchange over >1 data shard, the deduped touched-row
+        # stream for super-batch step i+1 is a pure function of its ids —
+        # so the fused scan can prefetch it (all-gather) concurrently
+        # with step i's rank-local apply (see make_scan_train_step).
+        # Resolved ONCE from the device config the step actually runs
+        # with; "on" on a path that cannot overlap refuses loudly
+        # (sparse_lib.overlap_active), never goes silently inert.
+        self._overlap_active = False
+        if cfg.sparse_exchange_overlap != "off":
+            blocked = not self.sparse or (
+                cfg.lookup == "shardmap" and self.mesh.size > 1
+            )
+            if blocked:
+                if cfg.sparse_exchange_overlap == "on":
+                    raise ValueError(
+                        "sparse_exchange_overlap=on requires the sparse "
+                        "gather/apply step (optimizer in adagrad/ftrl/"
+                        "sgd, lookup != shardmap); this run resolved to "
+                        + ("the dense step" if not self.sparse
+                           else "lookup=shardmap")
+                    )
+            else:
+                self._overlap_active = sparse_lib.overlap_active(
+                    dcfg, self.mesh
+                )
         step_fn = (
             make_sparse_train_step(dcfg, self.mesh)
             if self.sparse
@@ -581,10 +706,21 @@ class Trainer:
         if self._with_scores:
             # ys [K, B] shards like the stacked labels it aligns with.
             scan_out_sh = scan_out_sh + (self._super_batch_sh.labels,)
+        prefetch_fn = None
+        if self._overlap_active:
+            prefetch_fn = sparse_lib.sparse_apply.make_entries_prefetch(
+                self.mesh, mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS,
+                dcfg.vocabulary_size,
+            )
+            log.info(
+                "sparse exchange overlap active: entries streams "
+                "prefetch one scan step ahead of the rank-local apply"
+            )
         self._scan_health_jit = jax.jit(
             make_scan_train_step(
                 step_fn_health, make_health_update(dcfg),
                 with_scores=self._with_scores,
+                prefetch_fn=prefetch_fn,
             ),
             in_shardings=(state_sh, health_sh, self._super_batch_sh),
             out_shardings=scan_out_sh,
@@ -618,11 +754,28 @@ class Trainer:
         self._dispatches = 0  # per-run dispatch count (throughput attr.)
         self._run_steps = 0  # per-run step count, visible to the sentinel
         # Shape-derived device-memory estimate: table + optimizer-slot
-        # bytes of the DEVICE state (with tiering on, the hot tables).
-        # The truth where the backend reports it (memory_stats on TPU);
-        # this is the documented CPU fallback, computed once.
+        # bytes of THIS PROCESS's device state (with tiering on, the hot
+        # tables).  Summed over addressable shards with replica dedupe —
+        # equal to x.nbytes single-process, and ~1/R per rank for the
+        # P(MODEL)-sharded tables of a fleet (the bench's sharded-vs-
+        # global byte assertion reads exactly this).  The truth where
+        # the backend reports it (memory_stats on TPU); this is the
+        # documented CPU fallback, computed once.
+        def leaf_bytes(x):
+            try:
+                shards = x.addressable_shards
+            except Exception:  # pragma: no cover - non-Array leaf
+                return int(x.nbytes)
+            uniq = {}
+            for sh in shards:
+                key = tuple(
+                    (sl.start, sl.stop) for sl in sh.index
+                )
+                uniq[key] = int(sh.data.nbytes)
+            return sum(uniq.values())
+
         self._state_bytes_est = int(sum(
-            x.nbytes for x in jax.tree.leaves(
+            leaf_bytes(x) for x in jax.tree.leaves(
                 (self.state.params, self.state.opt_state)
             )
         ))
@@ -637,32 +790,75 @@ class Trainer:
             # Migration jits: gather the evicted slots' current rows
             # (async D2H write-back source) and overwrite loaded slots
             # with cold rows (the pad slot index == hot_rows scatter-
-            # drops).  Tables keep their row sharding; slot/row operands
-            # are replicated.  The load donates the old tables so the
-            # hot-table buffers are reused in place.
+            # drops).  Tables keep their row sharding.  The load donates
+            # the old tables so the hot-table buffers are reused in
+            # place.
             n_tab = 1 + len(tiered_lib.opt_table_names(cfg.optimizer))
             tab_sh = (param_sh.table,) * n_tab
+            if self._tiering_sharded:
+                # Fleet variant: slot/row plan arrays are P(MODEL)-
+                # sharded (each process supplied only its own columns'
+                # blocks in _put_super) and the bodies run under
+                # shard_map with NO collectives — each column touches
+                # only its own rows, so cross-rank migration traffic is
+                # structurally zero.  Slots are column-LOCAL with pad
+                # == hs (the per-column scatter-drop index).
+                mp = mesh_lib.MODEL_AXIS
+                tab_spec = (P(mp, None),) * n_tab
+                slot_sh = NamedSharding(self.mesh, P(mp))
+                row_sh = (param_sh.table,) * n_tab
 
-            def _gather_fn(tables, slots):
-                return tuple(t[slots] for t in tables)
+                def _gather_fn(tables, slots):
+                    return tuple(t[slots] for t in tables)
 
-            def _load_fn(tables, slots, rows):
-                return tuple(
-                    t.at[slots].set(r, mode="drop")
-                    for t, r in zip(tables, rows)
+                def _load_fn(tables, slots, rows):
+                    return tuple(
+                        t.at[slots].set(r, mode="drop")
+                        for t, r in zip(tables, rows)
+                    )
+
+                self._tier_gather_jit = jax.jit(
+                    platform.shard_map(
+                        _gather_fn, mesh=self.mesh,
+                        in_specs=(tab_spec, P(mp)),
+                        out_specs=tab_spec,
+                    ),
+                    in_shardings=(tab_sh, slot_sh),
+                    out_shardings=tab_sh,
                 )
+                self._tier_load_jit = jax.jit(
+                    platform.shard_map(
+                        _load_fn, mesh=self.mesh,
+                        in_specs=(tab_spec, P(mp), tab_spec),
+                        out_specs=tab_spec,
+                    ),
+                    in_shardings=(tab_sh, slot_sh, row_sh),
+                    out_shardings=tab_sh,
+                    donate_argnums=0,
+                )
+            else:
+                # Host-global variant: slot/row operands replicated.
 
-            self._tier_gather_jit = jax.jit(
-                _gather_fn,
-                in_shardings=(tab_sh, rep),
-                out_shardings=(rep,) * n_tab,
-            )
-            self._tier_load_jit = jax.jit(
-                _load_fn,
-                in_shardings=(tab_sh, rep, (rep,) * n_tab),
-                out_shardings=tab_sh,
-                donate_argnums=0,
-            )
+                def _gather_fn(tables, slots):
+                    return tuple(t[slots] for t in tables)
+
+                def _load_fn(tables, slots, rows):
+                    return tuple(
+                        t.at[slots].set(r, mode="drop")
+                        for t, r in zip(tables, rows)
+                    )
+
+                self._tier_gather_jit = jax.jit(
+                    _gather_fn,
+                    in_shardings=(tab_sh, rep),
+                    out_shardings=(rep,) * n_tab,
+                )
+                self._tier_load_jit = jax.jit(
+                    _load_fn,
+                    in_shardings=(tab_sh, rep, (rep,) * n_tab),
+                    out_shardings=tab_sh,
+                    donate_argnums=0,
+                )
             self._tiered_eval_jit = None  # built lazily (merged eval)
 
     def _opt_shardings(self, param_sh, params_template):
@@ -771,9 +967,7 @@ class Trainer:
                 "warm-starting tiered table from overlay checkpoint %s "
                 "(step %d)", cfg.model_file, step,
             )
-            self.tiered = tiered_lib.TieredTable(
-                cfg, telemetry=self.telemetry, overlay=stores
-            )
+            self.tiered = self._make_tier_manager(overlay=stores)
             params = params._replace(w0=put_scalar(scalars["w0"]))
             opt_state = tiered_lib.set_opt_scalars(
                 cfg.optimizer, opt_init(params), scalars, put_scalar
@@ -819,13 +1013,30 @@ class Trainer:
                     tiered_lib.get_opt_scalars(cfg.optimizer, opt_np),
                     put_scalar,
                 )
-            self.tiered = tiered_lib.TieredTable(
-                cfg, telemetry=self.telemetry, dense_tables=dense_tables
+            self.tiered = self._make_tier_manager(
+                dense_tables=dense_tables
             )
             return params, opt_state
         self._restored_step = 0
-        self.tiered = tiered_lib.TieredTable(cfg, telemetry=self.telemetry)
+        self.tiered = self._make_tier_manager()
         return params, opt_init(params)
+
+    def _make_tier_manager(self, dense_tables=None, overlay=None):
+        """The tier manager this run's partition mode calls for: the
+        host-global :class:`tiered_lib.TieredTable`, or (tiered_partition
+        = shards) the rank-sharded coordinator — restore payloads are
+        GLOBAL either way (the coordinator slices per shard itself, which
+        is what makes checkpoints elastic across shard counts)."""
+        if self._tiering_sharded:
+            return tiered_fleet.ShardedTiering(
+                self.cfg, self._tier_shards, self._tier_owned,
+                telemetry=self.telemetry, dense_tables=dense_tables,
+                overlay=overlay,
+            )
+        return tiered_lib.TieredTable(
+            self.cfg, telemetry=self.telemetry,
+            dense_tables=dense_tables, overlay=overlay,
+        )
 
     def _ftrl_normalize_np(self, np_params, opt_np):
         """Host-side mirror of :meth:`_check_ftrl_invariant` for the
@@ -1210,6 +1421,39 @@ class Trainer:
         """
         if self.tiered is None:
             return mesh_lib.shard_super_batch(batch, self.mesh)
+        if self._tiering_sharded:
+            # Fleet tiering: every rank remaps the SAME global batch
+            # through its lockstep shard mirrors, then materializes the
+            # P(MODEL)-sharded plan arrays from PROCESS-LOCAL blocks —
+            # each rank stages only its own columns' cold rows, so
+            # migration H2D is ~1/R per rank by construction.
+            new_ids, fplan = self.tiered.plan(batch.ids)
+            batch = batch._replace(ids=new_ids, sort_meta=None)
+            dev = mesh_lib.shard_super_batch(batch, self.mesh)
+            slots_h, rows_h = self.tiered.local_load_blocks(fplan)
+            evict_h = self.tiered.local_evict_slots(fplan)
+            S = self.tiered.num_shards
+            dim = self.tiered.dim
+            slot_sh = NamedSharding(self.mesh, P(mesh_lib.MODEL_AXIS))
+            row_sh = NamedSharding(
+                self.mesh, P(mesh_lib.MODEL_AXIS, None)
+            )
+            return tiered_fleet.FleetShipment(
+                batch=dev,
+                load_slots=jax.make_array_from_process_local_data(
+                    slot_sh, slots_h, (S * fplan.cap_load,)
+                ),
+                load_rows=tuple(
+                    jax.make_array_from_process_local_data(
+                        row_sh, r, (S * fplan.cap_load, dim)
+                    )
+                    for r in rows_h
+                ),
+                evict_slots=jax.make_array_from_process_local_data(
+                    slot_sh, evict_h, (S * fplan.cap_evict,)
+                ),
+                plan=fplan,
+            )
         new_ids, plan = self.tiered.plan(batch.ids)
         batch = batch._replace(ids=new_ids, sort_meta=None)
         dev = mesh_lib.shard_super_batch(batch, self.mesh)
@@ -1238,6 +1482,8 @@ class Trainer:
         lands before the dispatch that needs the new rows.  Returns the
         device super-batch to dispatch.
         """
+        if self._tiering_sharded:
+            return self._apply_migration_fleet(shipment)
         man = self.tiered
         state = self.state
         tables = (state.params.table,) + tiered_lib.get_opt_tables(
@@ -1262,6 +1508,56 @@ class Trainer:
                 ),
             )
             man.note_applied(shipment)
+        return shipment.batch
+
+    def _apply_migration_fleet(
+        self, shipment: "tiered_fleet.FleetShipment"
+    ) -> Batch:
+        """Fleet half of :meth:`_apply_migration`: the gathered evict
+        rows come back P(MODEL)-sharded, and each OWNED column's block
+        is handed to its shard's write-back ledger directly from the
+        device shard — no rank ever holds another rank's rows."""
+        man = self.tiered
+        state = self.state
+        fplan = shipment.plan
+        tables = (state.params.table,) + tiered_lib.get_opt_tables(
+            self.cfg.optimizer, state.opt_state
+        )
+        if fplan.n_evict_max:
+            rows = self._tier_gather_jit(tables, shipment.evict_slots)
+            cap_e = fplan.cap_evict
+            # shard index -> per-table device blocks, deduped across
+            # data-axis replicas (same column, same values).
+            blocks: dict = {}
+            for r in rows:
+                got: dict = {}
+                for sh in r.addressable_shards:
+                    s = (sh.index[0].start or 0) // cap_e
+                    if s in got:
+                        continue
+                    got[s] = sh.data
+                    try:
+                        sh.data.copy_to_host_async()
+                    except Exception:  # pragma: no cover - drift
+                        pass
+                for s, d in got.items():
+                    blocks.setdefault(s, []).append(d)
+            for s in sorted(man.owned):
+                if fplan.shard_plans[s].n_evict and s in blocks:
+                    man.push_writeback(
+                        s, fplan.plan_id, tuple(blocks[s])
+                    )
+        if fplan.n_load_max:
+            new_tables = self._tier_load_jit(
+                tables, shipment.load_slots, shipment.load_rows
+            )
+            self.state = state._replace(
+                params=state.params._replace(table=new_tables[0]),
+                opt_state=tiered_lib.set_opt_tables(
+                    self.cfg.optimizer, state.opt_state, new_tables[1:]
+                ),
+            )
+            man.note_applied(fplan)
         return shipment.batch
 
     def _sort_meta_spec(self):
@@ -1406,6 +1702,12 @@ class Trainer:
                 "hot_rows": (
                     cfg.hot_rows if cfg.table_tiering == "on" else 0
                 ),
+                "tiered_partition": cfg.tiered_partition,
+                "tiered_shards": (
+                    self._tier_shards if self._tiering_sharded else 0
+                ),
+                "sparse_exchange_overlap": cfg.sparse_exchange_overlap,
+                "exchange_overlap_active": self._overlap_active,
                 "cold_dtype": cfg.cold_dtype,
                 "batch_size": cfg.batch_size,
                 "epoch_num": cfg.epoch_num,
@@ -1878,11 +2180,18 @@ class Trainer:
                         # Migration first: eviction gather reads the
                         # previous dispatch's row values, the load lands
                         # before this dispatch gathers its rows.
+                        plan = getattr(super_batch, "plan", None)
                         with t_migr.time(), self.tracer.span(
                             "train.migrate",
                             args={"sb": dispatch_idx,
-                                  "loads": super_batch.n_load,
-                                  "evicts": super_batch.n_evict},
+                                  "loads": (
+                                      plan.n_load_max if plan is not None
+                                      else super_batch.n_load
+                                  ),
+                                  "evicts": (
+                                      plan.n_evict_max if plan is not None
+                                      else super_batch.n_evict
+                                  )},
                         ):
                             super_batch = self._apply_migration(super_batch)
                     if (
@@ -1915,17 +2224,30 @@ class Trainer:
                     # sentinel stamps on `record: compile` entries.
                     self._dispatches = dispatch_idx
                     self._run_steps = stepno
-                    # Exchange timing, one dispatch delayed: enqueue
-                    # THIS dispatch's barrier probe (it runs behind the
+                    # Exchange timing: with the overlapped exchange
+                    # active, one dispatch delayed — enqueue THIS
+                    # dispatch's barrier probe (it runs behind the
                     # dispatch on every rank's stream), then block on
-                    # the PREVIOUS one — already resolved at parity, so
-                    # the wait measures only cross-rank lag.
+                    # the PREVIOUS one, already resolved at parity, so
+                    # the wait measures only the residual cross-rank
+                    # lag the overlap did not hide.  WITHOUT overlap
+                    # the probe blocks immediately: the synchronous
+                    # window (dispatch + exchange at the barrier) is
+                    # exactly the cost the overlap exists to remove,
+                    # so the off/on pair of exchange_frac readings is
+                    # directly comparable (bench fleet_train's A/B).
                     if exchange_probe is not None:
                         probe_out = exchange_probe()
-                        if pending_exchange is not None:
+                        if self._overlap_active:
+                            if pending_exchange is not None:
+                                with t_exch.time():
+                                    jax.block_until_ready(
+                                        pending_exchange
+                                    )
+                            pending_exchange = probe_out
+                        else:
                             with t_exch.time():
-                                jax.block_until_ready(pending_exchange)
-                        pending_exchange = probe_out
+                                jax.block_until_ready(probe_out)
                     # Health readback, one dispatch delayed: start an
                     # async D2H copy of THIS dispatch's scalars, then
                     # consume the PREVIOUS dispatch's (already resident —
@@ -2239,6 +2561,16 @@ class Trainer:
             # densely; huge-V virtual stores score each batch against a
             # compact per-batch table instead (no dense table ever
             # materializes).
+            if self._tiering_sharded and (
+                len(self.tiered.owned) != self.tiered.num_shards
+            ):
+                raise RuntimeError(
+                    "evaluate with fleet-sharded tiering needs every "
+                    "shard's cold store; this rank owns "
+                    f"{sorted(self.tiered.owned)} of "
+                    f"{self.tiered.num_shards}.  Evaluate from the "
+                    "saved checkpoint instead (it merges all shards)."
+                )
             if self._tiered_eval_jit is None:
                 self._tiered_eval_jit = jax.jit(
                     make_eval_step(self.cfg), donate_argnums=1
@@ -2291,7 +2623,7 @@ class Trainer:
         are identical), without ever materializing [V, D].  No new
         dispatches run during evaluation, so the synced cold store is a
         consistent snapshot."""
-        self.tiered.sync_from_device(self._hot_host_tables())
+        self.tiered.sync_from_device(self._tier_host_tables())
         rep = NamedSharding(self.mesh, P())
         w0 = jax.device_put(self.state.params.w0, rep)
         vocab = self.cfg.vocabulary_size
@@ -2325,13 +2657,41 @@ class Trainer:
         )
         return [np.asarray(t) for t in tabs]
 
+    def _hot_host_tables_by_shard(self) -> dict:
+        """Fleet view of :meth:`_hot_host_tables`: {shard -> np copies
+        of that COLUMN's hot-table rows, params first} for this rank's
+        owned shards, read straight from the addressable device shards
+        (deduped across data-axis replicas) — a rank never materializes
+        another rank's rows."""
+        tabs = (self.state.params.table,) + tiered_lib.get_opt_tables(
+            self.cfg.optimizer, self.state.opt_state
+        )
+        hs = self._dcfg.vocabulary_size // self.tiered.num_shards
+        out = {s: [] for s in sorted(self.tiered.owned)}
+        for t in tabs:
+            got = {}
+            for sh in t.addressable_shards:
+                s = (sh.index[0].start or 0) // hs
+                if s in got:
+                    continue
+                got[s] = np.asarray(sh.data)
+            for s in out:
+                out[s].append(got[s])
+        return out
+
+    def _tier_host_tables(self):
+        """The host-table payload the active tier manager expects."""
+        if self._tiering_sharded:
+            return self._hot_host_tables_by_shard()
+        return self._hot_host_tables()
+
     def _tiered_logical_params(self) -> fm.FmParams:
         """The merged logical params (hot written back over cold) as a
         replicated device FmParams — the eval/predict view of a tiered
         table.  Only feasible when the logical table materializes
         densely (small V); huge-V tiered runs score via the training
         path, not a merged table."""
-        merged = self.tiered.merged_dense(self._hot_host_tables())
+        merged = self.tiered.merged_dense(self._tier_host_tables())
         rep = NamedSharding(self.mesh, P())
         return fm.FmParams(
             w0=jax.device_put(self.state.params.w0, rep),
@@ -2379,7 +2739,7 @@ class Trainer:
         # tiered-restore only).
         cfg = self.cfg
         step = self._restored_step + stepno
-        host_tables = self._hot_host_tables()
+        host_tables = self._tier_host_tables()
         w0 = np.asarray(self.state.params.w0)
         opt_scalars = tiered_lib.get_opt_scalars(
             cfg.optimizer, self.state.opt_state
@@ -2407,6 +2767,30 @@ class Trainer:
             )  # checkpoint.save clears any stale overlay itself
             return
         scalars = {"w0": w0, **opt_scalars}
+        if self._tiering_sharded:
+            # Elastic per-shard files: every rank writes its OWNED
+            # shards, a fleet barrier orders the writes before rank 0
+            # cleans stale formats and publishes the manifest (torn
+            # saves stay detectable: restore refuses a mixed/partial
+            # shard set).
+            barrier = None
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                def barrier():
+                    multihost_utils.sync_global_devices(
+                        "tffm_tiered_shard_save"
+                    )
+            checkpoint.save_tiered_shards(
+                cfg.model_file, step, scalars,
+                self.tiered.export_shard_overlays(host_tables),
+                num_shards=self.tiered.num_shards,
+                data_state=data_state,
+                manifest_extra=self._manifest_quality(),
+                primary=jax.process_index() == 0,
+                barrier=barrier,
+            )
+            return
         checkpoint.save_tiered(
             cfg.model_file, step, scalars,
             self.tiered.export_overlay(host_tables),

@@ -184,8 +184,48 @@ def _sharded_exchange(cfg, mesh, ids, g_rows) -> str:
     )
 
 
+def overlap_active(cfg: FmConfig, mesh=None) -> bool:
+    """Resolve ``cfg.sparse_exchange_overlap`` against the path actually
+    taken: compute-overlapped exchange needs the entries exchange's id
+    plane (the deduped row streams are a pure function of batch ids, so
+    they can be computed one dispatch ahead) — i.e. the GSPMD 'sharded'
+    apply with resolved exchange 'entries' over >1 data shard.
+
+    'auto' enables exactly when those hold; 'on' refuses loudly when they
+    don't (a silently inert knob would fake the overlap win); 'off' never
+    overlaps.  Callers pass the cfg the step actually runs with (the
+    hot-table _dcfg under tiering, whose vocabulary is the hot size).
+    """
+    if cfg.sparse_exchange_overlap == "off":
+        return False
+    ok = mesh is not None and mesh.shape[mesh_lib.DATA_AXIS] > 1
+    if ok:
+        ok = supports_sparse(cfg) and apply_mode(cfg, mesh) == "sharded"
+    if ok:
+        n_occ = cfg.batch_size * cfg.max_features
+        resolved = sparse_apply.resolve_exchange(
+            cfg.sparse_exchange,
+            n_local_occ=n_occ // mesh.shape[mesh_lib.DATA_AXIS],
+            vocab_local=(
+                cfg.vocabulary_size // mesh.shape[mesh_lib.MODEL_AXIS]
+            ),
+            d=cfg.embedding_dim,
+            data_shards=mesh.shape[mesh_lib.DATA_AXIS],
+        )
+        ok = resolved == "entries"
+    if cfg.sparse_exchange_overlap == "on" and not ok:
+        raise ValueError(
+            "sparse_exchange_overlap=on requires the sharded sparse apply "
+            "with resolved exchange 'entries' over >1 data shard (got "
+            f"mesh={None if mesh is None else dict(mesh.shape)}, "
+            f"sparse_exchange={cfg.sparse_exchange!r}); use 'auto' to "
+            "overlap opportunistically"
+        )
+    return ok
+
+
 def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows,
-                   mode="scatter", mesh=None, meta=None):
+                   mode="scatter", mesh=None, meta=None, rows_all=None):
     del w_rows  # adagrad needs no pre-update weights
     # Same formula as optax.scale_by_rss: u = g * rsqrt(acc_new + eps),
     # so sparse and dense paths agree exactly on duplicate-free batches.
@@ -196,6 +236,7 @@ def _apply_adagrad(cfg, params, opt, ids, g_rows, dw0, w_rows,
             lr=lr, eps=ADAGRAD_EPS, mesh=mesh,
             data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
             exchange=_sharded_exchange(cfg, mesh, ids, g_rows),
+            rows_all=rows_all,
         )
     elif mode == "tile":
         table, acc_table = sparse_apply.adagrad_apply(
@@ -221,7 +262,7 @@ _ftrl_solve = sparse_apply.ftrl_solve
 
 
 def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows,
-                mode="scatter", mesh=None, meta=None):
+                mode="scatter", mesh=None, meta=None, rows_all=None):
     lr, l1, l2, beta = (
         cfg.learning_rate, cfg.ftrl_l1, cfg.ftrl_l2, cfg.ftrl_beta,
     )
@@ -231,6 +272,7 @@ def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows,
             lr=lr, l1=l1, l2=l2, beta=beta, mesh=mesh,
             data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
             exchange=_sharded_exchange(cfg, mesh, ids, g_rows),
+            rows_all=rows_all,
         )
     elif mode == "tile":
         table, z_table, n_table = sparse_apply.ftrl_apply(
@@ -274,7 +316,7 @@ def _apply_ftrl(cfg, params, opt, ids, g_rows, dw0, w_rows,
 
 
 def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows,
-               mode="scatter", mesh=None, meta=None):
+               mode="scatter", mesh=None, meta=None, rows_all=None):
     del w_rows
     lr = cfg.learning_rate
     if mode == "sharded":
@@ -282,6 +324,7 @@ def _apply_sgd(cfg, params, opt, ids, g_rows, dw0, w_rows,
             params.table, ids, g_rows, lr=lr, mesh=mesh,
             data_axis=mesh_lib.DATA_AXIS, model_axis=mesh_lib.MODEL_AXIS,
             exchange=_sharded_exchange(cfg, mesh, ids, g_rows),
+            rows_all=rows_all,
         )
     elif mode == "tile":
         table = sparse_apply.sgd_apply(
@@ -353,11 +396,17 @@ def grad_health(g_rows, dw0):
 def sparse_step(
     cfg: FmConfig, params: fm.FmParams, opt_state, batch: Batch,
     mesh=None, data_axis: str = "data", health: bool = False,
+    rows_all=None,
 ):
     """One sparse train step. Returns (params, opt_state, scores), plus
     a ``(grad_sq, nonfinite_count)`` health aux when ``health=True``
     (computed from the per-occurrence row grads this step already
-    materialized — no extra memory traffic)."""
+    materialized — no extra memory traffic).
+
+    ``rows_all`` is the prefetched entries-exchange id plane (see
+    ops.sparse_apply.make_entries_prefetch) — only legal on the sharded
+    entries path, where it lifts the deduped-stream all-gather off the
+    critical path (compute-overlapped exchange)."""
     rows = params.table[batch.ids]  # [B, F, D]
     loss_fn = _rows_loss_fn(
         cfg, batch, mesh, data_axis, compute_dtype=cfg.compute_jnp_dtype
@@ -369,10 +418,16 @@ def sparse_step(
     ids = batch.ids.reshape(b * f)
     g_rows = drows.reshape(b * f, d)
     mode = apply_mode(cfg, mesh)
+    if rows_all is not None and mode != "sharded":
+        raise ValueError(
+            f"prefetched exchange streams need apply mode 'sharded', got "
+            f"{mode!r}"
+        )
     params, opt_state = _APPLY[cfg.optimizer](
         cfg, params, opt_state, ids, g_rows, dw0, rows.reshape(b * f, d),
         mode=mode, mesh=mesh,
         meta=batch.sort_meta if mode == "tile" else None,
+        rows_all=rows_all,
     )
     if health:
         return params, opt_state, scores, grad_health(g_rows, dw0)

@@ -358,11 +358,19 @@ class ColdStore:
         """Sparse overlay payload for the tiered checkpoint format.
         ``rows`` is the PACKED storage array (codec-specific width) —
         the descriptor's dtype names the format, and a restore stores
-        the packed rows verbatim (no decode/re-encode drift)."""
+        the packed rows verbatim (no decode/re-encode drift).
+
+        Dense-backed stores export EVERY row (the overlay degenerates to
+        the dense slice) — the rank-sharded checkpoint path needs this:
+        no single rank can assemble the merged dense array, so each
+        shard's store serializes in the overlay format regardless of
+        backing.  Single-process dense-backed saves keep using the
+        ordinary dense format (``dense_save_ok``)."""
         if self._dense is not None:
-            raise ValueError(
-                "dense-backed cold stores checkpoint in the dense format"
-            )
+            return {
+                "ids": np.arange(self.vocab, dtype=np.int64),
+                "rows": self._dense.copy(),
+            }
         self._compact()
         return {"ids": self._ids.copy(), "rows": self._rows.copy()}
 
@@ -399,14 +407,23 @@ def _virtual_descriptor(cfg: FmConfig, name: str) -> dict:
     return desc
 
 
-def _virtual_store(cfg: FmConfig, name: str) -> ColdStore:
-    vocab, dim = cfg.vocabulary_size, cfg.embedding_dim
+def _virtual_store(cfg: FmConfig, name: str, *, vocab: Optional[int] = None,
+                   id_offset: int = 0) -> ColdStore:
+    """Virtual cold store over ``vocab`` rows.  ``id_offset`` keys the
+    hash init in GLOBAL id space: a rank-sharded store over local ids
+    [0, vs) initializes row i exactly like the host-global store
+    initializes row ``id_offset + i`` — the property that makes sharded
+    and global tiering element-wise identical (and shard overlays
+    layout-independent once ids are globalized)."""
+    vocab = cfg.vocabulary_size if vocab is None else vocab
+    dim = cfg.embedding_dim
+    off = np.int64(id_offset)
     desc = _virtual_descriptor(cfg, name)
     if desc["kind"] == "uniform":
         seed, r = desc["seed"], desc["range"]
 
         def init_rows(ids):
-            return _hash_uniform(ids, dim, seed, r)
+            return _hash_uniform(ids + off, dim, seed, r)
     elif desc["kind"] == "const":
         v = desc["value"]
 
@@ -419,16 +436,24 @@ def _virtual_store(cfg: FmConfig, name: str) -> ColdStore:
         denom0, l1 = np.float32(desc["denom0"]), np.float32(desc["l1"])
 
         def init_rows(ids):
-            p = _hash_uniform(ids, dim, seed, r)
+            p = _hash_uniform(ids + off, dim, seed, r)
             return -p * denom0 - np.sign(p) * l1
     return ColdStore(vocab, dim, desc, init_rows=init_rows,
                      codec=quant.cold_codec(cfg))
 
 
 def _exact_stores(cfg: FmConfig, names: tuple,
-                  params_table: Optional[np.ndarray]) -> dict:
+                  params_table: Optional[np.ndarray],
+                  row_range: Optional[tuple] = None) -> dict:
     """Dense-backed stores materialized via the SAME jax init the dense
-    trainer uses — bit-identical starting point, pinned by tier-1."""
+    trainer uses — bit-identical starting point, pinned by tier-1.
+
+    ``row_range=(lo, hi)`` slices the GLOBAL init down to a rank shard's
+    id span: the full table is drawn once (exact mode only exists where
+    that fits) and everything outside the shard is dropped, so a sharded
+    shard's rows are bitwise the rows a host-global store holds.  A
+    provided ``params_table`` is already in the caller's (possibly
+    local) space — the optimizer init is elementwise, so no slicing."""
     import jax
 
     from fast_tffm_tpu.models import fm
@@ -437,10 +462,9 @@ def _exact_stores(cfg: FmConfig, names: tuple,
     if params_table is None:
         params = fm.init_params(jax.random.PRNGKey(cfg.seed), cfg)
         params_table = np.asarray(params.table)
-    else:
-        params = fm.FmParams(
-            w0=np.zeros((), np.float32), table=params_table
-        )
+        if row_range is not None:
+            params_table = params_table[row_range[0]:row_range[1]].copy()
+    params = fm.FmParams(w0=np.zeros((), np.float32), table=params_table)
     codec = quant.cold_codec(cfg)
     stores = {
         "table": ColdStore.from_dense(
@@ -461,6 +485,25 @@ def _exact_stores(cfg: FmConfig, names: tuple,
 # ----------------------------------------------------------------------
 # Migration plan + manager
 # ----------------------------------------------------------------------
+
+
+class ShardSpec(NamedTuple):
+    """Which slice of the logical table a :class:`TieredTable` instance
+    manages under rank-sharded tiering (train.tiered_fleet).
+
+    ``index``/``count`` carve the id space into ``count`` contiguous
+    ranges; the instance then operates entirely in LOCAL coordinates
+    (vocab ``V/count``, hot rows ``H/count``, local ids/slots).  With
+    ``rows_enabled=False`` the instance is a metadata MIRROR: it tracks
+    the slot map + LRU deterministically (every rank plans every shard
+    over identical global batches, so mirrors stay in lockstep with the
+    owner at zero communication) but builds no cold stores, fetches no
+    rows, and keeps no write-back ledger — per-rank host bytes and
+    migration traffic stay ~1/R."""
+
+    index: int = 0
+    count: int = 1
+    rows_enabled: bool = True
 
 
 class Plan(NamedTuple):
@@ -511,12 +554,24 @@ class TieredTable:
 
     def __init__(self, cfg: FmConfig, telemetry=None,
                  dense_tables: Optional[dict] = None,
-                 overlay: Optional[dict] = None):
+                 overlay: Optional[dict] = None,
+                 shard: Optional[ShardSpec] = None):
         from fast_tffm_tpu import obs
 
         self.cfg = cfg
-        self.vocab = cfg.vocabulary_size
-        self.hot_rows = min(cfg.hot_rows, cfg.vocabulary_size)
+        self.shard = shard if shard is not None else ShardSpec()
+        v_global = cfg.vocabulary_size
+        h_global = min(cfg.hot_rows, cfg.vocabulary_size)
+        if v_global % self.shard.count or h_global % self.shard.count:
+            raise ValueError(
+                f"vocabulary_size={v_global} and hot_rows={h_global} must "
+                f"both divide by the tier shard count "
+                f"{self.shard.count} (contiguous id-range ownership)"
+            )
+        self.vocab = v_global // self.shard.count
+        self.hot_rows = h_global // self.shard.count
+        self.id_offset = self.shard.index * self.vocab
+        self.rows_enabled = bool(self.shard.rows_enabled)
         self.dim = cfg.embedding_dim
         self.codec = quant.cold_codec(cfg)
         self.names = ("table",) + opt_table_names(cfg.optimizer)
@@ -548,6 +603,11 @@ class TieredTable:
         self._rows_evicted = 0
         self._rows_written_back = 0
         self._seen_rows = 0  # distinct logical ids ever resident
+        # Mirrors never touch rows, so their counters must not inflate
+        # this rank's tiered.* telemetry — the per-rank numbers are the
+        # ~1/R claim the fleet bench asserts.
+        if not self.rows_enabled:
+            telemetry = None
         tel = telemetry if telemetry is not None else obs.NULL
         self._c_hit = tel.counter("tiered.hit_occurrences")
         self._c_miss = tel.counter("tiered.miss_occurrences")
@@ -562,13 +622,21 @@ class TieredTable:
 
     def _build_stores(self, dense_tables, overlay) -> tuple:
         cfg = self.cfg
+        if not self.rows_enabled:
+            return ()
         codec = quant.cold_codec(cfg)
-        exact = self.vocab * self.dim * 4 <= EXACT_BYTES_MAX
+        # Exact-vs-virtual is decided on the GLOBAL table bytes, never
+        # the shard slice: all shard counts of the same config must pick
+        # the same mode, or an elastic resume would try to restore one
+        # format into the other.
+        exact = cfg.vocabulary_size * self.dim * 4 <= EXACT_BYTES_MAX
         if dense_tables is not None:
-            # Warm start from a dense checkpoint (always small V).  Any
-            # missing optimizer store initializes from the RESTORED
-            # params — same semantics as the dense path's opt_init on
-            # restored params.
+            # Warm start from a dense checkpoint (always small V).  The
+            # caller hands arrays already sliced to this shard's id
+            # range.  Any missing optimizer store initializes from the
+            # RESTORED params — same semantics as the dense path's
+            # opt_init on restored params (elementwise, so it works in
+            # local coordinates).
             stores = {
                 name: ColdStore.from_dense(
                     arr, {"kind": "restored"}, codec
@@ -584,15 +652,36 @@ class TieredTable:
                     stores[n] = fresh[n]
             return tuple(stores[n] for n in self.names)
         if exact:
-            built = _exact_stores(cfg, self.names, None)
+            row_range = (
+                None if self.shard.count == 1
+                else (self.id_offset, self.id_offset + self.vocab)
+            )
+            built = _exact_stores(cfg, self.names, None, row_range)
         else:
-            built = {n: _virtual_store(cfg, n) for n in self.names}
+            built = {
+                n: _virtual_store(cfg, n, vocab=self.vocab,
+                                  id_offset=self.id_offset)
+                for n in self.names
+            }
         if overlay is not None:
             for name in self.names:
                 payload = overlay[name]
                 want = built[name].descriptor
                 got = payload.get("descriptor")
-                if got is not None and got != want:
+                # kind="dense" overlays carry EVERY row's value (a
+                # rank-sharded save of a dense-backed store), so they
+                # are init-independent and restore onto any store of
+                # matching storage format.
+                if got is not None and got.get("kind") == "dense":
+                    fmt = {k: v for k, v in got.items() if k != "kind"}
+                    want_fmt = codec.descriptor()
+                    if fmt != want_fmt:
+                        raise ValueError(
+                            f"tiered checkpoint store {name!r} was packed "
+                            f"as {fmt} but this run's cold_dtype expects "
+                            f"{want_fmt}"
+                        )
+                elif got is not None and got != want:
                     raise ValueError(
                         f"tiered checkpoint store {name!r} was written "
                         f"under a different init ({got} != {want}); "
@@ -688,14 +777,18 @@ class TieredTable:
                         )
                     evict_ids = self.id_of_slot[cand].copy()
                     self.slot_of[evict_ids] = _EVICTED
-                    entry = {
-                        "ids": evict_ids, "dev": None, "host": None,
-                        "skip": set(),
-                    }
-                    self._entries[pid] = entry
-                    self._entry_q.append(pid)
-                    for j, i in enumerate(evict_ids):
-                        self._pending[int(i)] = (entry, j)
+                    if self.rows_enabled:
+                        # Mirrors mark _EVICTED (slot-map bookkeeping)
+                        # but keep no write-back ledger: the owner rank
+                        # captures the values.
+                        entry = {
+                            "ids": evict_ids, "dev": None, "host": None,
+                            "skip": set(),
+                        }
+                        self._entries[pid] = entry
+                        self._entry_q.append(pid)
+                        for j, i in enumerate(evict_ids):
+                            self._pending[int(i)] = (entry, j)
                     new_slots[n_fresh:] = cand
                     evict_slots = cand
                     self._rows_evicted += n_evict
@@ -706,7 +799,8 @@ class TieredTable:
                 self.slot_of[miss_ids] = new_slots
                 self.id_of_slot[new_slots] = miss_ids
                 self.last_used[new_slots] = t
-                rows = self._fetch(miss_ids)
+                if self.rows_enabled:
+                    rows = self._fetch(miss_ids)
                 self._rows_loaded += n_miss
                 self._c_load.add(n_miss)
             else:
@@ -731,7 +825,7 @@ class TieredTable:
                     pr = np.zeros((mp, r.shape[1]), np.float32)
                     pr[:n_miss] = r
                     pad_rows.append(pr)
-            else:
+            elif self.rows_enabled:
                 pad_rows = [
                     np.zeros((mp, self.dim), np.float32) for _ in self.names
                 ]
@@ -878,6 +972,11 @@ class TieredTable:
         CURRENT device hot tables, ordered like ``self.names``.  Uses
         the applied view, so plans still in flight (whose evicted rows
         are still on device) are swept correctly."""
+        if not self.rows_enabled:
+            raise RuntimeError(
+                "sync_from_device on a mirror tier shard: only the owning "
+                "rank holds this shard's cold stores"
+            )
         with self._cv:
             self._flush_entries(force=True)
             slots = np.nonzero(self.id_of_slot_applied >= 0)[0]
@@ -890,6 +989,11 @@ class TieredTable:
         """Current PARAMS rows for logical ids, from the cold store
         (callers sync the hot rows back first — the evaluate path).
         Locked against concurrent write-back flushes."""
+        if not self.rows_enabled:
+            raise RuntimeError(
+                "gather_logical on a mirror tier shard: only the owning "
+                "rank holds this shard's cold stores"
+            )
         with self._cv:
             return self.stores[0].gather(ids)
 
@@ -905,13 +1009,22 @@ class TieredTable:
             return [s.to_dense().copy() for s in self.stores]
 
     def export_overlay(self, host_tables: list) -> dict:
-        """Sparse overlay checkpoint payload (virtual stores)."""
+        """Sparse overlay checkpoint payload.  Virtual stores export
+        their written-row overlay under the init descriptor; dense-backed
+        stores export EVERY row under ``kind="dense"`` (init-independent
+        — the rank-sharded save path, where no rank can write the merged
+        dense checkpoint)."""
         self.sync_from_device(host_tables)
         with self._cv:
             out = {}
             for name, s in zip(self.names, self.stores):
                 payload = s.export()
-                payload["descriptor"] = s.descriptor
+                if s.dense_backed:
+                    payload["descriptor"] = {
+                        "kind": "dense", **self.codec.descriptor()
+                    }
+                else:
+                    payload["descriptor"] = s.descriptor
                 out[name] = payload
             return out
 
@@ -941,7 +1054,7 @@ class TieredTable:
                     sum(s.nbytes for s in self.stores)
                 ),
                 "cold_written_rows": int(
-                    0 if self.stores[0].dense_backed
+                    0 if not self.stores or self.stores[0].dense_backed
                     else self.stores[0].written_rows
                 ),
                 # Storage-format identity of the cold rows: the dtype

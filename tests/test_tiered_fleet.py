@@ -1,0 +1,253 @@
+"""Rank-sharded tiered table + overlapped sparse exchange (ISSUE 19).
+
+The pinned guarantees:
+
+  * parity — ``tiered_partition=shards`` training is ELEMENT-WISE
+    IDENTICAL to host-global tiered training AND to dense training on
+    the same mesh (merged logical table, opt tables, loss, auc), for
+    Adagrad and FTRL, with and without eviction churn, across K;
+  * elastic resume — per-shard overlay checkpoints re-shard across a
+    fleet-size change (S=1 -> S=2 and back) bitwise, and a partial
+    shard set is refused loudly;
+  * overlap — ``sparse_exchange_overlap=on`` produces bitwise-identical
+    params to ``off`` (the prefetched entry streams are a pure function
+    of the batch ids), while an impossible ``on`` refuses at build.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.train import checkpoint, tiered
+from fast_tffm_tpu.train.loop import Trainer
+
+V = 256
+
+
+def _write_data(path, rng, lines=256, vocab=V):
+    with open(path, "w") as f:
+        for i in range(lines):
+            f.write(
+                f"{i % 2} {rng.integers(0, vocab)}:1 "
+                f"{rng.integers(0, vocab)}:0.5 "
+                f"{rng.integers(0, vocab)}:0.25\n"
+            )
+
+
+def _cfg(tmp_path, model, **kw):
+    defaults = dict(
+        vocabulary_size=V, factor_num=4, max_features=4, batch_size=32,
+        train_files=[str(tmp_path / "train.libsvm")],
+        model_file=str(tmp_path / model),
+        epoch_num=2, log_steps=0, thread_num=1, seed=3,
+        steps_per_dispatch=2,
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+def _merged(trainer):
+    return trainer.tiered.merged_dense(trainer._tier_host_tables())
+
+
+# ------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("optimizer,hot_rows,k", [
+    ("adagrad", 160, 2),   # eviction churn
+    ("adagrad", V, 2),     # no churn
+    ("ftrl", 160, 2),
+    ("adagrad", 160, 1),   # K=1 dispatch
+    ("ftrl", V, 4),        # K=4 dispatch
+])
+def test_sharded_matches_global_and_dense(tmp_path, rng, optimizer,
+                                          hot_rows, k):
+    """The parity matrix: on one mesh (1 data x 2 model columns) the
+    rank-sharded tiered run, the host-global tiered run, and the dense
+    run agree element-wise — loss, auc, merged logical table."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    mesh = dict(mesh_data=1, mesh_model=2, optimizer=optimizer,
+                steps_per_dispatch=k)
+    rd = Trainer(_cfg(tmp_path, "dense", **mesh)).train()
+    tg = Trainer(_cfg(
+        tmp_path, "tglobal", table_tiering="on", hot_rows=hot_rows,
+        tiered_partition="global", **mesh,
+    ))
+    rg = tg.train()
+    ts = Trainer(_cfg(
+        tmp_path, "tshards", table_tiering="on", hot_rows=hot_rows,
+        tiered_partition="shards", **mesh,
+    ))
+    rs = ts.train()
+    assert rs["train"]["loss"] == rg["train"]["loss"] == \
+        rd["train"]["loss"]
+    assert rs["train"]["auc"] == rg["train"]["auc"] == rd["train"]["auc"]
+    ms, mg = _merged(ts), _merged(tg)
+    assert len(ms) == len(mg)
+    for a, b in zip(ms, mg):  # params table + optimizer slot tables
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        np.asarray(ts.state.params.w0), np.asarray(tg.state.params.w0)
+    )
+    snap = rs["train"]["tiered"]
+    assert snap["num_shards"] == 2 and snap["owned_shards"] == 2
+    if hot_rows < V:
+        assert snap["rows_evicted"] > 0  # churn actually exercised
+
+
+def test_sharded_auto_resolves_global_single_process(tmp_path, rng):
+    """``tiered_partition=auto`` on one process is host-global: no
+    sharded coordinator, identical behavior to the pre-fleet path."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    t = Trainer(_cfg(tmp_path, "t", table_tiering="on", hot_rows=160,
+                     mesh_data=1, mesh_model=2))
+    assert not t._tiering_sharded
+    assert isinstance(t.tiered, tiered.TieredTable)
+
+
+def test_sharded_refuses_indivisible_geometry(tmp_path, rng):
+    """hot_rows (and V) must split evenly across the model columns —
+    a lopsided shard would silently skew per-rank capacity."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    with pytest.raises(ValueError, match="divis"):
+        Trainer(_cfg(tmp_path, "t", table_tiering="on", hot_rows=81,
+                     tiered_partition="shards",
+                     mesh_data=1, mesh_model=2))
+
+
+# ----------------------------------------------------- elastic resume
+
+
+def test_elastic_resume_reshards_bitwise(tmp_path, rng, monkeypatch):
+    """Per-shard overlay checkpoints are elastic: a save under S=1
+    restores under S=2 (and back) with every touched logical row
+    bitwise intact, and training continues in the new geometry."""
+    monkeypatch.setattr(tiered, "EXACT_BYTES_MAX", 0)  # force overlay
+    _write_data(tmp_path / "train.libsvm", rng)
+
+    def cfg(s, **kw):
+        return _cfg(tmp_path, "m", table_tiering="on", hot_rows=192,
+                    tiered_partition="shards", epoch_num=1,
+                    mesh_data=1, mesh_model=s, **kw)
+
+    t1 = Trainer(cfg(1))
+    t1.train()
+    assert checkpoint.exists_tiered(str(tmp_path / "m"))
+    step1, scalars1, stores1 = checkpoint.restore_tiered(
+        str(tmp_path / "m"))
+    assert step1 == 8
+    ids = np.asarray(stores1["table"]["ids"])
+    rows = np.asarray(stores1["table"]["rows"])
+    assert len(ids) > 0
+
+    # S=1 -> S=2: the merged overlay filters into two shard-local
+    # cold stores; every saved logical row survives bitwise.
+    t2 = Trainer(cfg(2))
+    assert t2._restored_step == step1
+    assert t2.tiered.num_shards == 2
+    np.testing.assert_array_equal(t2.tiered.gather_logical(ids), rows)
+    np.testing.assert_array_equal(
+        np.asarray(t2.state.params.w0), scalars1["w0"])
+    r2 = t2.train()  # continues in the new geometry
+    assert r2["train"]["steps"] == 8 and np.isfinite(r2["train"]["loss"])
+
+    # The S=2 save wrote one file per shard, with a manifest.
+    step2, _, stores2 = checkpoint.restore_tiered(str(tmp_path / "m"))
+    assert step2 == 16
+
+    # S=2 -> S=1: the two shard files merge back into one store.
+    t3 = Trainer(cfg(1))
+    assert t3._restored_step == step2
+    np.testing.assert_array_equal(
+        t3.tiered.gather_logical(np.asarray(stores2["table"]["ids"])),
+        np.asarray(stores2["table"]["rows"]))
+    r3 = t3.train()
+    assert r3["train"]["steps"] == 8
+
+
+def test_elastic_restore_refuses_partial_shard_set(tmp_path, rng,
+                                                   monkeypatch):
+    """A torn fleet save (missing shard file) refuses loudly instead of
+    silently resuming from a partial table."""
+    monkeypatch.setattr(tiered, "EXACT_BYTES_MAX", 0)
+    _write_data(tmp_path / "train.libsvm", rng)
+    c = _cfg(tmp_path, "m", table_tiering="on", hot_rows=192,
+             tiered_partition="shards", epoch_num=1,
+             mesh_data=1, mesh_model=2)
+    Trainer(c).train()
+    shard0 = tmp_path / "m" / "tiered.shard0of2.npz"
+    assert shard0.exists()
+    shard0.unlink()
+    with pytest.raises(ValueError):
+        Trainer(c)
+
+
+# ----------------------------------------------------------- overlap
+
+
+def _overlap_cfg(tmp_path, model, **kw):
+    defaults = dict(
+        vocabulary_size=1024, factor_num=4, max_features=4,
+        batch_size=32,
+        train_files=[str(tmp_path / "train.libsvm")],
+        model_file=str(tmp_path / model),
+        epoch_num=2, log_steps=0, thread_num=1, seed=3,
+        steps_per_dispatch=2,
+        mesh_data=2, mesh_model=2,
+        sparse_apply="tile", sparse_exchange="entries",
+    )
+    defaults.update(kw)
+    return FmConfig(**defaults)
+
+
+def test_overlap_on_off_bitwise_pin(tmp_path, rng):
+    """Compute-overlapped exchange changes WHEN the merged entry
+    streams are built, never WHAT they contain: final params are
+    bitwise identical with the overlap on and off."""
+    _write_data(tmp_path / "train.libsvm", rng, vocab=1024)
+    toff = Trainer(_overlap_cfg(tmp_path, "off",
+                                sparse_exchange_overlap="off"))
+    roff = toff.train()
+    ton = Trainer(_overlap_cfg(tmp_path, "on",
+                               sparse_exchange_overlap="on"))
+    assert ton._overlap_active
+    ron = ton.train()
+    assert not toff._overlap_active
+    assert ron["train"]["loss"] == roff["train"]["loss"]
+    assert ron["train"]["auc"] == roff["train"]["auc"]
+    np.testing.assert_array_equal(
+        np.asarray(ton.state.params.table),
+        np.asarray(toff.state.params.table))
+    np.testing.assert_array_equal(
+        np.asarray(ton.state.params.w0),
+        np.asarray(toff.state.params.w0))
+
+
+def test_overlap_composes_with_sharded_tiering(tmp_path, rng):
+    """The full tentpole in one run: rank-sharded tiering + entries
+    exchange + overlap matches the host-global, non-overlapped run
+    element-wise."""
+    _write_data(tmp_path / "train.libsvm", rng, vocab=1024)
+    base = dict(table_tiering="on", hot_rows=512)
+    tg = Trainer(_overlap_cfg(tmp_path, "g", tiered_partition="global",
+                              sparse_exchange_overlap="off", **base))
+    rg = tg.train()
+    ts = Trainer(_overlap_cfg(tmp_path, "s", tiered_partition="shards",
+                              sparse_exchange_overlap="on", **base))
+    assert ts._overlap_active and ts._tiering_sharded
+    rs = ts.train()
+    assert rs["train"]["loss"] == rg["train"]["loss"]
+    for a, b in zip(_merged(ts), _merged(tg)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_overlap_on_refuses_unoverlappable_run(tmp_path, rng):
+    """``on`` with nothing to overlap (one data shard -> no cross-rank
+    exchange) is a silently-inert knob: refuse at build time."""
+    _write_data(tmp_path / "train.libsvm", rng)
+    with pytest.raises(ValueError, match="overlap"):
+        Trainer(_cfg(tmp_path, "t", sparse_exchange_overlap="on"))

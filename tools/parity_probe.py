@@ -28,6 +28,17 @@ Usage:
       [--dispatches 8] [--out parity_probe.jsonl]
       [--atol 1e-6] [--rtol 1e-5]
 
+``--fleet-gate`` is the second mode (ISSUE 19): a cheap 2-rank CPU
+(gloo) gate.  Two real OS processes join a jax.distributed cluster,
+build the canonical fleet mesh (data=1, model=2 — one model column per
+rank), and run init + N training dispatches on the same batch stream a
+single-process (1x2) reference runs locally.  Each rank sha256-hashes
+its ADDRESSABLE table block after init and after every dispatch; the
+parent compares rank r's hash against the reference's model-shard-r
+block hash.  Bitwise equality is the contract (the `[4-2]` fix made
+sharded init layout-independent), so the gate catches both init drift
+and cross-process step drift in ~3 dispatches.
+
 Exit code: 0 when the meshes agree over every dispatch, 3 when a
 divergent dispatch was found (so CI can notice the red moving), 1 on
 setup errors.
@@ -119,6 +130,172 @@ def _record(tag: str, mesh_shape: str, dispatch: int,
     }
 
 
+# The 2-rank gloo worker: joins the cluster, builds the canonical fleet
+# mesh (data=1, model=2), trains N dispatches on the seeded batch
+# stream, and prints one FLEETHASH line per (rank, dispatch) — the
+# sha256 of this rank's ADDRESSABLE table block.  argv: coordinator,
+# rank, seed, dispatches.
+_FLEET_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+# CPU cross-process collectives need the gloo transport.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+assert jax.process_count() == 2 and jax.device_count() == 2
+
+import hashlib
+import numpy as np
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.libsvm import Batch
+from fast_tffm_tpu.train.loop import Trainer
+
+rank, seed, n = int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+cfg = FmConfig(
+    vocabulary_size=256, factor_num=4, max_features=8, batch_size=64,
+    mesh_data=1, mesh_model=2,
+    model_file="/tmp/fftpu_fleet_gate_" + sys.argv[2], log_steps=0,
+)
+t = Trainer(cfg)
+rng = np.random.default_rng(seed)
+
+
+def h():
+    parts = [np.ascontiguousarray(np.asarray(s.data))
+             for s in t.state.params.table.addressable_shards]
+    return hashlib.sha256(
+        b"".join(p.tobytes() for p in parts)
+    ).hexdigest()[:16]
+
+
+print("FLEETHASH", rank, -1, h(), flush=True)
+for i in range(n):
+    b = Batch(
+        labels=rng.integers(0, 2, size=(64,)).astype(np.float32),
+        ids=rng.integers(0, 256, size=(64, 8)).astype(np.int32),
+        vals=rng.uniform(0.1, 1.0, size=(64, 8)).astype(np.float32),
+        fields=np.zeros((64, 8), np.int32),
+        weights=np.ones((64,), np.float32),
+    )
+    t.state = t._train_step(t.state, t._put(b))
+    print("FLEETHASH", rank, i, h(), flush=True)
+"""
+
+
+def _fleet_gate(args) -> int:
+    """Init+N-step hash gate: 2 gloo ranks vs the 1-process (1x2)
+    reference, compared bitwise per model shard per dispatch."""
+    import socket
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    n = args.dispatches
+    scratch = args.workdir or tempfile.mkdtemp(prefix="fleet_gate_")
+    os.makedirs(scratch, exist_ok=True)
+
+    # Reference: the SAME logical mesh (1 data x 2 model) on one
+    # process, same seeded batch stream.
+    cfg = _cfg(os.path.join(scratch, "ref"), mesh_data=1, mesh_model=2)
+    t_ref = Trainer(
+        cfg, mesh=mesh_lib.make_mesh(cfg, jax.devices()[:2])
+    )
+    rng = np.random.default_rng(args.seed)
+    ref_hashes = {-1: _shard_hashes(_table(t_ref), 2)}
+    for i in range(n):
+        b = _batch(rng, cfg)
+        t_ref.state = t_ref._train_step(t_ref.state, t_ref._put(b))
+        ref_hashes[i] = _shard_hashes(_table(t_ref), 2)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    script = os.path.join(scratch, "fleet_worker.py")
+    with open(script, "w") as f:
+        f.write(_FLEET_WORKER)
+    print(f"fleet gate: 2 gloo ranks (1 device each) vs 1x2 "
+          f"reference, init + {n} dispatches")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, coordinator, str(r),
+             str(args.seed), str(n)],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            if p.returncode != 0:
+                print(f"fleet worker failed (rc={p.returncode}):\n"
+                      f"{err[-3000:]}", file=sys.stderr)
+                return 1
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+    # rank_hashes[r][d] = hash of rank r's table block after dispatch d.
+    rank_hashes = {0: {}, 1: {}}
+    for line in (ln for o in outs for ln in o.splitlines()):
+        if line.startswith("FLEETHASH "):
+            _, r, d, hx = line.split()
+            rank_hashes[int(r)][int(d)] = hx
+    first_divergent = None
+    records = []
+    for d in [-1] + list(range(n)):
+        match = [
+            rank_hashes[r].get(d) == ref_hashes[d][r] for r in range(2)
+        ]
+        records.append({
+            "record": "fleet_gate",
+            "dispatch": d,
+            "rank_hashes": [rank_hashes[r].get(d) for r in range(2)],
+            "ref_hashes": ref_hashes[d],
+            "match": match,
+        })
+        tag = "init" if d == -1 else f"dispatch {d}"
+        ok = all(match)
+        if not ok and first_divergent is None:
+            first_divergent = d
+        print(f"  {tag}: ranks "
+              f"{'== reference' if ok else '!= reference ' + str(match)}")
+    with open(args.out, "w") as out:
+        for rec in records:
+            out.write(json.dumps(rec) + "\n")
+        out.write(json.dumps({
+            "record": "fleet_gate_summary",
+            "dispatches": n,
+            "first_divergent_dispatch": first_divergent,
+            "agree": first_divergent is None,
+        }) + "\n")
+    if first_divergent is None:
+        print(f"\nfleet gate: 2-rank table blocks bitwise-match the "
+              f"single-process reference over init + {n} dispatches")
+        return 0
+    where = "init" if first_divergent == -1 else \
+        f"dispatch {first_divergent}"
+    print(f"\nfleet gate: DIVERGED at {where} — per-dispatch records "
+          f"in {args.out}")
+    return 3
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="localize the first divergent dispatch between a "
@@ -130,12 +307,18 @@ def main(argv=None) -> int:
     ap.add_argument("--atol", type=float, default=1e-6)
     ap.add_argument("--rtol", type=float, default=1e-5)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fleet-gate", action="store_true",
+                    help="2-rank gloo init+N-step hash gate against "
+                         "the single-process (1x2) reference")
     ap.add_argument("--out", default="parity_probe.jsonl",
                     help="per-dispatch JSONL dump (default "
                          "parity_probe.jsonl)")
     ap.add_argument("--workdir", default=None,
                     help="model_file scratch dir (default: a tempdir)")
     args = ap.parse_args(argv)
+
+    if args.fleet_gate:
+        return _fleet_gate(args)
 
     d, m = args.mesh_data, args.mesh_model
     if d * m > len(jax.devices()):

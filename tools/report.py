@@ -1153,6 +1153,25 @@ _DIRECTION_OVERRIDES = {
     "fleet.ranks_scraped": None, "fleet.scrape_age_max_s": None,
     "fleet.examples_in": None, "fleet.ingest_wait_frac": "low",
     "fleet_scrape_overhead": "low",
+    # Rank-sharded tiering + overlapped exchange (ISSUE 19): the
+    # synchronous exchange window fraction and its overlapped
+    # counterpart regress when they RISE (overlap stops hiding the
+    # merge); the per-rank device-bytes fraction vs the host-global
+    # baseline regresses when it RISES back toward 1.0 (sharding
+    # stopped shedding table+optimizer memory); the sharded step rate
+    # is a plain throughput axis.  The geometry echoes (shards, the
+    # off-run rate) are informational.
+    "fleet_exchange_frac": "low",
+    "fleet_exchange_overlap_frac": "low",
+    "fleet_shard_bytes_frac": "low",
+    "fleet_cold_bytes_frac": "low",
+    "fleet_sharded_examples_per_sec": "high",
+    "fleet_global_examples_per_sec": None,
+    "fleet_tier_shards": None,
+    # Bench preflight (--timeline over the BENCH_r*.json stack): any
+    # key whose trend already crossed its threshold counts here — a
+    # new one appearing is itself a regression signal.
+    "timeline_regressions": "low",
 }
 
 
@@ -1383,29 +1402,27 @@ def _bench_order(path: str):
     return (0, int(m.group(1)), path) if m else (1, 0, path)
 
 
-def timeline_mode(paths: list, thresholds: dict) -> int:
-    """Trend view over a stack of bench JSONs (BENCH_rN.json): one
-    sparkline row per shared key plus first-regression attribution —
-    the earliest round whose step beyond ``--threshold`` moved in the
-    regressing direction for that key (same direction vocabulary as
-    ``--compare``).  Informational: always exits 0."""
-    paths = sorted(paths, key=_bench_order)
-    default = thresholds.get("default", 0.05)
+def _timeline_series(paths: list, log=None) -> tuple:
+    """Load a bench-JSON stack into ``(labels, {key: [(label, val),
+    ...]})`` — numeric top-level keys only, unreadable/stub rounds
+    skipped (``log`` gets one line per skip when provided)."""
     series: dict = {}
     labels = []
-    for path in paths:
+    for path in sorted(paths, key=_bench_order):
         try:
             with open(path) as f:
                 doc = json.load(f)
         except (OSError, ValueError) as e:
-            print(f"{path}: unreadable ({e}); skipped")
+            if log:
+                log(f"{path}: unreadable ({e}); skipped")
             continue
         if not isinstance(doc, dict) or "metric" not in doc:
             # Harness stubs from rounds where the bench never ran
             # (rc!=0 wrappers) carry no metric keys — skip, don't
             # fake a flat round.
-            print(f"{os.path.basename(path)}: no bench metrics; "
-                  f"skipped")
+            if log:
+                log(f"{os.path.basename(path)}: no bench metrics; "
+                    f"skipped")
             continue
         label = os.path.basename(path)
         labels.append(label)
@@ -1414,24 +1431,27 @@ def timeline_mode(paths: list, thresholds: dict) -> int:
                 val, bool
             ):
                 series.setdefault(key, []).append((label, float(val)))
+    return labels, series
+
+
+def timeline_regressions(paths: list, thresholds: dict = None) -> dict:
+    """Machine-readable first-regression attribution over a bench-JSON
+    stack — the same adjacent-step rule ``--timeline`` prints, for
+    callers that gate on it (bench.py preflight records the count).
+    Returns ``{"rounds": N, "regressions": {key: "rA -> rB (1.23x)"}}``
+    (empty regressions when fewer than two readable rounds)."""
+    thresholds = thresholds or {}
+    default = thresholds.get("default", 0.05)
+    labels, series = _timeline_series(paths)
+    out: dict = {"rounds": len(labels), "regressions": {}}
     if len(labels) < 2:
-        print("--timeline needs at least two readable bench JSONs")
-        return 1
-    print(f"timeline over {len(labels)} rounds: "
-          f"{labels[0]} .. {labels[-1]} "
-          f"(step threshold {default:.0%})")
-    print(f"  {'key':34} {'trend':>{max(5, len(labels))}} "
-          f"{'first':>10} {'last':>10} {'l/f':>7}  first regression")
+        return out
     for key in sorted(series):
         points = series[key]
         if len(points) < 2:
             continue
-        vals = [v for _lab, v in points]
         direction = _direction(key)
         threshold = thresholds.get(key, default)
-        # First-regression attribution: the earliest adjacent step
-        # whose ratio moved beyond the threshold the WRONG way.
-        culprit = ""
         for (lab_a, va), (lab_b, vb) in zip(points, points[1:]):
             if va == 0 and vb == 0:
                 continue
@@ -1444,8 +1464,37 @@ def timeline_mode(paths: list, thresholds: dict) -> int:
             ):
                 rs = (f"{ratio:.2f}x" if ratio != float("inf")
                       else "inf")
-                culprit = f"{lab_a} -> {lab_b} ({rs})"
+                out["regressions"][key] = f"{lab_a} -> {lab_b} ({rs})"
                 break
+    return out
+
+
+def timeline_mode(paths: list, thresholds: dict) -> int:
+    """Trend view over a stack of bench JSONs (BENCH_rN.json): one
+    sparkline row per shared key plus first-regression attribution —
+    the earliest round whose step beyond ``--threshold`` moved in the
+    regressing direction for that key (same direction vocabulary as
+    ``--compare``).  Informational: always exits 0."""
+    default = thresholds.get("default", 0.05)
+    labels, series = _timeline_series(paths, log=print)
+    if len(labels) < 2:
+        print("--timeline needs at least two readable bench JSONs")
+        return 1
+    culprits = timeline_regressions(paths, thresholds)["regressions"]
+    print(f"timeline over {len(labels)} rounds: "
+          f"{labels[0]} .. {labels[-1]} "
+          f"(step threshold {default:.0%})")
+    print(f"  {'key':34} {'trend':>{max(5, len(labels))}} "
+          f"{'first':>10} {'last':>10} {'l/f':>7}  first regression")
+    for key in sorted(series):
+        points = series[key]
+        if len(points) < 2:
+            continue
+        vals = [v for _lab, v in points]
+        # First-regression attribution: the earliest adjacent step
+        # whose ratio moved beyond the threshold the WRONG way
+        # (timeline_regressions is the single rule source).
+        culprit = culprits.get(key, "")
         lf = vals[-1] / vals[0] if vals[0] else float("inf")
         lfs = f"{lf:7.3f}" if lf != float("inf") else "    inf"
         print(f"  {key:34} {_sparkline(vals):>{max(5, len(labels))}} "

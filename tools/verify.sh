@@ -37,6 +37,15 @@ echo "== kernel-autotune invariants (tools/autotune.py --check) =="
 JAX_PLATFORMS=cpu python tools/autotune.py --check || exit 1
 
 echo
+echo "== fleet parity gate (tools/parity_probe.py --fleet-gate) =="
+# Two real gloo ranks (one model column each) vs the single-process
+# (1x2) reference: per-shard table hashes must match bitwise at init
+# and after each of 3 dispatches.  Catches cross-process init drift
+# and step drift in seconds, long before a full fleet bench would.
+JAX_PLATFORMS=cpu python tools/parity_probe.py --fleet-gate \
+    --dispatches 3 --out /tmp/_fleet_gate.jsonl || exit 1
+
+echo
 echo "== live observability + serving smoke (tools/obs_smoke.py) =="
 # A real CLI run with --status_port: /metrics must serve parseable
 # Prometheus text (incl. the resource block + tffm_build_info) and
