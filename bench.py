@@ -1423,6 +1423,84 @@ def _bench_serve(workers: int) -> dict:
                 _sh2.rmtree(trace_dir, ignore_errors=True)
         except Exception as e:  # noqa: BLE001 - probe must not sink it
             out["trace_probe_error"] = f"{type(e).__name__}: {e}"
+        # Paired traffic-capture overhead probe (ISSUE 20): identical
+        # client windows against the SAME warm scorer — capture OFF
+        # (the main stack) vs a TFC1 CaptureWriter sampling at 0.1 —
+        # back-to-back so box drift can't masquerade as overhead.
+        # capture_overhead = qps_off / qps_on; budget <= 1.05, the
+        # standard obs-overhead budget.
+        try:
+            import dataclasses as _dc4
+            import shutil as _sh4
+            import tempfile as _tf4
+
+            def _cap_window(url_: str, dur: float):
+                done = [0]
+
+                def cl(seed: int):
+                    r = np.random.default_rng(seed)
+                    end = time.perf_counter() + dur
+                    while time.perf_counter() < end:
+                        body = bodies[int(r.integers(0, len(bodies)))]
+                        try:
+                            _rq.urlopen(_rq.Request(
+                                url_, data=body, method="POST"
+                            ), timeout=30).read()
+                        except Exception:  # noqa: BLE001 - end window
+                            return
+                        with lat_lock:
+                            done[0] += 1
+
+                ths = [
+                    _th.Thread(target=cl, args=(900 + i,))
+                    for i in range(n_clients)
+                ]
+                w0 = time.perf_counter()
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+                return done[0], time.perf_counter() - w0
+
+            cap_dir = _tf4.mkdtemp(prefix="tffm_bench_capture_")
+            cap_path = os.path.join(cap_dir, "requests.capture")
+            c_cfg = _dc4.replace(
+                cfg, serve_capture_sample=0.1,
+                serve_capture_file=cap_path,
+            )
+            c_tel = _obs.Telemetry()
+            cap = _wire.CaptureWriter(
+                cap_path, sample=0.1, telemetry=c_tel,
+            )
+            c_batcher = ServeBatcher(
+                scorer, max_batch_wait_ms=cfg.max_batch_wait_ms,
+                queue_size=cfg.queue_size, telemetry=c_tel,
+            )
+            c_server = ServeServer(
+                0, c_batcher, c_cfg,
+                lambda: {"record": "status"}, telemetry=c_tel,
+                capture=cap,
+            )
+            try:
+                c_url = f"http://127.0.0.1:{c_server.port}/score"
+                _rq.urlopen(_rq.Request(
+                    c_url, data=bodies[0], method="POST"
+                ), timeout=60).read()
+                n_off, w_off = _cap_window(url, 2.0)
+                n_on, w_on = _cap_window(c_url, 2.0)
+                qps_off = n_off / w_off if w_off > 0 else 0.0
+                qps_on = n_on / w_on if w_on > 0 else 0.0
+                out["capture_overhead"] = (
+                    round(qps_off / qps_on, 4) if qps_on > 0 else -1.0
+                )
+                out["capture_requests"] = int(cap.count)
+            finally:
+                c_server.close()
+                c_batcher.close()
+                cap.close()
+                _sh4.rmtree(cap_dir, ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 - probe must not sink it
+            out["capture_probe_error"] = f"{type(e).__name__}: {e}"
         # Vectorized-parser speedup probe (ISSUE 16): the SAME decoded
         # request bodies through parse_request twice — the vec path
         # (the default this section serves with) vs the legacy

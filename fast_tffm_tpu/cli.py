@@ -198,6 +198,20 @@ def build_argparser() -> argparse.ArgumentParser:
              "through the plain jit path), no `resource` record block",
     )
     p.add_argument(
+        "--no_blackbox", action="store_true",
+        help="disable the incident flight recorder: no in-memory "
+             "evidence rings, no incidents/ bundles on alert breach or "
+             "crash, POST /incident answers 503 (bitwise-identical "
+             "training, byte-identical serving)",
+    )
+    p.add_argument(
+        "--incident_dir", default=None, metavar="DIR",
+        help="where incident bundles land (default: "
+             "<model_file>/incidents next to the checkpoint); each "
+             "process suffixes its bundle dirs rankN/pidN/router so "
+             "concurrent dumpers never collide",
+    )
+    p.add_argument(
         "--trace_rotate_events", type=int, default=None,
         help="rotate the trace buffer into trace.0.json, trace.1.json, "
              "... every N events (removes the in-memory cap for long "
@@ -274,6 +288,19 @@ def build_argparser() -> argparse.ArgumentParser:
              "connected cross-process span chain (request id minted "
              "or from X-Request-Id, propagated router->replica and "
              "echoed back; requires --trace; 0 = off)",
+    )
+    p.add_argument(
+        "--serve_capture_sample", type=float, default=None,
+        help="serve mode: append this fraction of scored requests "
+             "(request + response as canonical binary frames) to "
+             "--serve_capture_file for post-hoc replay "
+             "(tools/replay.py; 0 = off, serving is byte-identical)",
+    )
+    p.add_argument(
+        "--serve_capture_file", default=None, metavar="PATH",
+        help="TFC1 capture output path for --serve_capture_sample "
+             "(rotates to PATH.1 at 64 MiB; a managed fleet gives "
+             "each replica PATH.replicaN)",
     )
     p.add_argument(
         "--serve_slo_p99_ms", type=float, default=None,
@@ -385,7 +412,8 @@ def main(argv=None) -> int:
                     "serve_slo_p99_ms", "serve_slo_availability",
                     "serve_parse_mode", "serve_http_threads",
                     "serve_http_acceptors", "interaction_impl",
-                    "compile_cache_dir",
+                    "compile_cache_dir", "incident_dir",
+                    "serve_capture_sample", "serve_capture_file",
                     "quality_window", "metrics_file")
         if getattr(args, key) is not None
     }
@@ -397,6 +425,8 @@ def main(argv=None) -> int:
         overrides["quality"] = False
     if args.no_serve_canary:
         overrides["serve_canary"] = False
+    if args.no_blackbox:
+        overrides["blackbox"] = False
     cfg = load_config(args.cfg, overrides or None)
     _setup_logging(cfg.log_file or None)
     dist = _resolve_dist(args)

@@ -489,6 +489,33 @@ class FmConfig:
     # mesh_model=R), identical global batches on every rank, and
     # vocabulary/hot_rows divisible by mesh_model.
     tiered_partition: str = "auto"  # auto | global | shards
+    # Incident flight recorder (obs/blackbox.py; OBSERVABILITY.md
+    # "Incidents & capture"): every long-running process (trainer rank,
+    # serve replica, router) keeps fixed-memory rings of recent
+    # heartbeat records / alerts / trace tail, and dumps an
+    # incidents/<ts>_<reason>/ forensic bundle on any alert breach,
+    # crash-truthful final, or manual POST /incident.  Rings are a few
+    # hundred KB and touch no disk until an incident fires, so the
+    # recorder is on by default; off = no rings, no bundles, the
+    # /incident route answers 503 — bitwise-identical training and
+    # byte-identical serving (pinned by test).
+    blackbox: bool = True
+    # Where incident bundles land; "" derives <model_file>/incidents
+    # (training) or the serving checkpoint dir's incidents/ (serve).
+    # Setting it with blackbox off is refused (inert-knob discipline).
+    incident_dir: str = ""
+    # Serve traffic capture (serve/wire.py CaptureWriter): fraction of
+    # scored requests whose canonical request+response frames are
+    # appended to serve_capture_file in the TFC1 container (SERVING.md
+    # "Capture & replay") — replayable bit-for-bit by tools/replay.py
+    # against a live endpoint.  0 = off (byte-identical serving).
+    serve_capture_sample: float = 0.0
+    # TFC1 capture output path; rotates to <path>.1 at 64 MiB.  With
+    # --replicas N the router gives each managed replica its own
+    # <path>.replicaI.  Requires serve_capture_sample > 0 and vice
+    # versa (a capture file nothing samples into, or a sample rate with
+    # nowhere to land, is the silently-inert-knob bug).
+    serve_capture_file: str = ""
 
     def __post_init__(self) -> None:
         if self.vocabulary_size <= 0:
@@ -717,6 +744,30 @@ class FmConfig:
             raise ValueError(
                 "serve_trace_sample > 0 requires trace_file (sampled "
                 "request chains are written to the trace output)"
+            )
+        if not 0.0 <= self.serve_capture_sample <= 1.0:
+            raise ValueError(
+                "serve_capture_sample must be in [0, 1], got "
+                f"{self.serve_capture_sample}"
+            )
+        if self.serve_capture_sample > 0 and not self.serve_capture_file:
+            # The silently-inert-knob discipline: sampled captures need
+            # a file to land in.
+            raise ValueError(
+                "serve_capture_sample > 0 requires serve_capture_file "
+                "(captured request/response frames are appended there)"
+            )
+        if self.serve_capture_file and self.serve_capture_sample <= 0:
+            raise ValueError(
+                "serve_capture_file is set but serve_capture_sample is "
+                "0 — nothing would ever be captured; set a sample rate "
+                "or drop the file"
+            )
+        if self.incident_dir and not self.blackbox:
+            raise ValueError(
+                "incident_dir is set but blackbox is off — no incident "
+                "bundle could ever land there; enable blackbox or drop "
+                "incident_dir"
             )
         if self.serve_slo_p99_ms < 0:
             raise ValueError(
@@ -989,6 +1040,10 @@ _KEYMAP = {
     "cold_dtype": ("cold_dtype", str),
     "serve_table_dtype": ("serve_table_dtype", str),
     "quant_chunk": ("quant_chunk", int),
+    "blackbox": ("blackbox", _parse_bool),
+    "incident_dir": ("incident_dir", str),
+    "serve_capture_sample": ("serve_capture_sample", float),
+    "serve_capture_file": ("serve_capture_file", str),
 }
 
 

@@ -22,6 +22,7 @@ from fast_tffm_tpu.obs.alerts import (
     AlertEngine, AlertHaltError, AlertRule, halt_error,
     parse_rules, run_until_halt,
 )
+from fast_tffm_tpu.obs.blackbox import NULL_BLACKBOX, Blackbox
 from fast_tffm_tpu.obs.fleet import (
     MergeSpec, TrainFleet, labeled_lines, merge_blocks,
 )
@@ -31,7 +32,9 @@ from fast_tffm_tpu.obs.heartbeat import (
 from fast_tffm_tpu.obs.quality import (
     QualityMonitor, ServeSkewMonitor, StreamSketch,
 )
-from fast_tffm_tpu.obs.resource import CompileSentinel, read_rss
+from fast_tffm_tpu.obs.resource import (
+    CompileSentinel, basic_block, read_open_fds, read_rss,
+)
 from fast_tffm_tpu.obs.sketch import FreqSketch, QuantileSketch, SketchSet
 from fast_tffm_tpu.obs.status import StatusServer, render_prometheus
 from fast_tffm_tpu.obs.telemetry import (
@@ -47,7 +50,8 @@ __all__ = [
     "StatusServer", "render_prometheus",
     "AlertEngine", "AlertHaltError", "AlertRule", "halt_error",
     "parse_rules", "run_until_halt",
-    "CompileSentinel", "read_rss",
+    "CompileSentinel", "read_rss", "read_open_fds", "basic_block",
+    "Blackbox", "NULL_BLACKBOX",
     "FreqSketch", "QuantileSketch", "SketchSet",
     "QualityMonitor", "ServeSkewMonitor", "StreamSketch",
 ]

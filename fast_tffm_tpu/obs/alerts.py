@@ -103,6 +103,8 @@ _ALIASES = {
     "peak_rss_mb": "resource.peak_rss_mb",
     "rss_mb": "resource.rss_mb",
     "compile_s": "resource.compile_s",
+    "open_fds": "resource.open_fds",
+    "uptime_s": "resource.uptime_s",
     # Serving plane (the `serve` block a serve/router heartbeat
     # carries): the SLO burn rate, the router's shed fraction and
     # eviction count, and fleet-scrape staleness — the one-line-rule
@@ -291,12 +293,18 @@ class AlertEngine:
     """
 
     def __init__(self, rules: List[AlertRule], writer=None,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 on_alert: Optional[Callable[[dict], None]] = None):
         self.rules = list(rules)
         self.halted: Optional[dict] = None
         self.fired_total = 0
         self._writer = writer
         self._clock = clock
+        # Per-alert listener (the blackbox flight recorder): called on
+        # the heartbeat thread with each emitted alert record, AFTER
+        # the record is written/logged.  Exceptions are swallowed — a
+        # broken forensics hook must never cost the beat.
+        self._on_alert = on_alert
         # Breach state is keyed by rule POSITION, not rule.name: two
         # rules can share a name while differing in sustain/action
         # (e.g. "x > 1 : warn ; x > 1 for 3 : halt" as an escalation
@@ -381,6 +389,11 @@ class AlertEngine:
                     log.warning("alert record write failed: %s", e)
             if rule.action == "halt" and self.halted is None:
                 self.halted = alert
+            if self._on_alert is not None:
+                try:
+                    self._on_alert(alert)
+                except Exception as e:  # noqa: BLE001 - never kill a beat
+                    log.warning("alert listener failed: %s", e)
         # Update derived-signal state AFTER evaluation so rules see the
         # baseline/gap that excludes the current beat.
         gn = _resolve(record, "health.grad_norm")
@@ -388,3 +401,25 @@ class AlertEngine:
             self._grad_hist.append(gn)
         self._last_beat_t = now
         return emitted
+
+    def active_snapshot(self) -> dict:
+        """Live alert state as an ``alerts`` block for heartbeat/status
+        records: armed rule count, cumulative fires, the halt latch,
+        and per-rule ``active``/``streak`` (rendered by
+        ``render_prometheus`` as ``tffm_alert_active{rule="..."}`` so a
+        Prometheus scrape can see a currently-firing alert, not just
+        the JSONL stream)."""
+        return {
+            "armed": len(self.rules),
+            "fired_total": self.fired_total,
+            "halted": int(self.halted is not None),
+            "rules": [
+                {
+                    "rule": rule.name,
+                    "action": rule.action,
+                    "active": int(self._active[i]),
+                    "streak": self._streak[i],
+                }
+                for i, rule in enumerate(self.rules)
+            ],
+        }

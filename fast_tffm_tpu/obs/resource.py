@@ -51,7 +51,8 @@ import threading
 import time
 from typing import Optional
 
-__all__ = ["CompileSentinel", "read_rss"]
+__all__ = ["CompileSentinel", "read_rss", "read_open_fds",
+           "basic_block"]
 
 log = logging.getLogger(__name__)
 
@@ -110,6 +111,39 @@ def read_rss() -> tuple:
         except Exception:
             pass
     return rss, max(rss, peak)
+
+
+def read_open_fds() -> int:
+    """Open file-descriptor count of THIS process (one readdir of
+    ``/proc/self/fd``), or -1 where /proc is absent.  The fd-leak
+    signal for the socket-heavy serving fleet: a replica leaking one
+    socket per kept-alive connection climbs here long before accept()
+    starts failing.  Alertable as ``open_fds`` (alias for
+    ``resource.open_fds``)."""
+    import os
+
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:  # pragma: no cover - non-Linux
+        return -1
+
+
+def basic_block(t0: float) -> dict:
+    """The process-level slice of the ``resource`` block — RSS, uptime,
+    open fds — for hosts without a compile sentinel (serve replicas,
+    the router).  The trainer builds its richer block in the dispatch
+    loop; key spellings here MUST match it so one alert alias covers
+    both planes."""
+    rss, peak = read_rss()
+    out = {
+        "rss_mb": round(rss / (1024 * 1024), 1),
+        "peak_rss_mb": round(peak / (1024 * 1024), 1),
+        "uptime_s": round(time.time() - t0, 3),
+    }
+    fds = read_open_fds()
+    if fds >= 0:
+        out["open_fds"] = fds
+    return out
 
 
 class CompileSentinel:

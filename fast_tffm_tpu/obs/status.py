@@ -185,9 +185,23 @@ def render_prometheus(record: dict) -> str:
             for band, n in buckets.items():
                 lines.append(f'{base}_bucket{{band="{band}"}} {n}')
     for block in ("health", "tiered", "resource", "serve", "quality",
-                  "fleet"):
+                  "fleet", "alerts"):
         for key, val in sorted((record.get(block) or {}).items()):
             emit(f"tffm_{block}_{_prom_name(key)}", val)
+    # The alerts block's per-rule state renders as one labeled gauge per
+    # armed rule — the live-breach surface a Prometheus scrape needs
+    # (the JSONL stream only shows the breach EDGE, not the episode).
+    rules = (record.get("alerts") or {}).get("rules") or []
+    if rules:
+        lines.append("# HELP tffm_alert_active 1 while the rule's "
+                     "breach episode is live (0 = armed and quiet)")
+        lines.append("# TYPE tffm_alert_active gauge")
+        for rule in rules:
+            lines.append(
+                f'tffm_alert_active{{rule="'
+                f'{_label_value(rule.get("rule", ""))}"}} '
+                f'{int(rule.get("active") or 0)}'
+            )
     info = record.get("build_info")
     if isinstance(info, dict) and info:
         labels = ",".join(
@@ -554,6 +568,36 @@ class QuietHandler(BaseHTTPRequestHandler):
             )
         return True
 
+    def _post_incident(self, query: str, incident) -> None:
+        """Answer ``POST /incident[?reason=...]`` — the manual
+        flight-recorder trigger shared by the trainer status endpoint,
+        the serve replicas, and the router.  ``incident`` is the
+        owner's ``Blackbox.incident``-shaped callable returning the
+        bundle dir; ``None`` -> 503 (blackbox disabled on this run).
+        Any body is consumed (keep-alive correctness) and ignored —
+        the reason rides the query string."""
+        if "Content-Length" in self.headers:
+            if self._read_body(1 << 20) is None:
+                return
+        if incident is None:
+            self._send(
+                503, b"blackbox disabled on this run "
+                     b"(--no_blackbox)\n", "text/plain",
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        reason = (params.get("reason") or ["manual"])[0] or "manual"
+        try:
+            out = incident(reason)
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            self._send(
+                500, f"incident dump failed: {e}\n".encode(),
+                "text/plain",
+            )
+            return
+        body = (json.dumps({"incident_dir": out}) + "\n").encode()
+        self._send(200 if out else 503, body, "application/json")
+
 
 class StatusServer:
     """Serve ``/metrics`` + ``/status`` + ``/healthz`` for one run.
@@ -575,17 +619,22 @@ class StatusServer:
     every ``/metrics`` response — the hook the training-fleet plane
     uses for its per-rank ``tffm_train_rank_*`` labeled series
     (obs/fleet.py); its failures degrade to the base exposition, never
-    a dead scrape.  ``close()`` shuts the server down and joins its
-    thread; idempotent.
+    a dead scrape.  ``incident`` (optional) is the flight recorder's
+    ``Blackbox.incident``-shaped callable behind ``POST /incident``
+    (the manual forensic-bundle trigger); without it the route answers
+    503.  ``close()`` shuts the server down and joins its thread;
+    idempotent.
     """
 
     def __init__(self, port: int, build: Callable[[], Optional[dict]],
                  telemetry=None, host: str = "127.0.0.1",
                  profile: Optional[Callable[[float], str]] = None,
-                 metrics_extra: Optional[Callable[[], str]] = None):
+                 metrics_extra: Optional[Callable[[], str]] = None,
+                 incident=None):
         self._build = build
         self._profile = profile
         self._metrics_extra = metrics_extra
+        self._incident = incident
         self._profile_lock = threading.Lock()
         self._requests = (
             telemetry.counter("status.requests")
@@ -608,6 +657,15 @@ class StatusServer:
                     return
                 if path == "/profile":
                     self._do_profile(query)
+                    return
+                self._send(404, b"not found\n", "text/plain")
+
+            def do_POST(self) -> None:  # noqa: N802 - http.server API
+                if server._requests is not None:
+                    server._requests.add()
+                path, _, query = self.path.partition("?")
+                if path == "/incident":
+                    self._post_incident(query, server._incident)
                     return
                 self._send(404, b"not found\n", "text/plain")
 

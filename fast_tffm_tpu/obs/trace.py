@@ -281,6 +281,16 @@ class Tracer:
             evs, self._events = self._events, []
         return evs
 
+    def tail(self, n: int = 256) -> list:
+        """The last ``n`` buffered events WITHOUT draining them — the
+        blackbox flight recorder's view of "what was the process doing
+        just now".  Shallow copies, safe to serialize after the tracer
+        moves on."""
+        if not self.enabled or n <= 0:
+            return []
+        with self._lock:
+            return [dict(ev) for ev in self._events[-n:]]
+
     def add_raw(self, events) -> None:
         """Ingest events shipped from another Tracer (they already carry
         their own pid/tid; perf_counter is host-wide, so no shifting)."""

@@ -192,7 +192,7 @@ class _ReplicaProc:
 _NO_PASSTHROUGH = {
     "serve_replicas", "serve_port", "serve_host", "serve_canary",
     "serve_poll_secs", "metrics_file", "trace_file",
-    "serve_trace_sample", "alert_rules",
+    "serve_trace_sample", "alert_rules", "serve_capture_file",
 }
 
 # Respawn backoff (ROADMAP direction-3 leftover): a died MANAGED
@@ -268,6 +268,14 @@ def _replica_command(cfg: FmConfig, cfg_path: str, index: int,
         # Same one-file-per-process rule for traces; report.py
         # --serve-trace merges the family back onto one timeline.
         cmd += ["--trace", f"{cfg.trace_file}.replica{index}"]
+    if cfg.serve_capture_file:
+        # Same one-file-per-process rule for TFC1 captures: replicas
+        # score (and therefore capture) the traffic, each into its own
+        # rotating file; tools/replay.py re-drives any of them.
+        cmd += [
+            "--serve_capture_file",
+            f"{cfg.serve_capture_file}.replica{index}",
+        ]
     return cmd + _passthrough_flags(overrides)
 
 
@@ -451,6 +459,16 @@ class ServeRouter:
             def do_POST(self) -> None:  # noqa: N802 - http.server API
                 router._c_requests.add()
                 path = self.path.partition("?")[0]
+                if path == "/incident":
+                    # Manual forensic dump (same admin route as the
+                    # replicas' own endpoints, but this one captures
+                    # the ROUTER's rings: fleet scrapes, shed state).
+                    bb = router.blackbox
+                    self._post_incident(
+                        self.path.partition("?")[2],
+                        bb.incident if bb is not None else None,
+                    )
+                    return
                 if path not in ("/score", "/score_bin"):
                     self._send(404, b"not found\n", "text/plain")
                     return
@@ -513,7 +531,12 @@ class ServeRouter:
 
         # Every attribute a handler can touch must exist BEFORE the
         # HTTP thread starts: on a fixed, well-known port a retrying
-        # client can connect the instant the socket binds.
+        # client can connect the instant the socket binds.  The
+        # blackbox and alert engine are mounted by start_fleet AFTER
+        # construction (they want the run header / router heartbeat),
+        # so they start as None here.
+        self.blackbox = None
+        self.alert_engine = None
         self._closed = False
         self._canary_thread = (
             threading.Thread(
@@ -1305,6 +1328,12 @@ class ServeRouter:
             "serve": block,
             "stages": snap,
         }
+        if self.cfg.resource_metrics:
+            rec["resource"] = obs.basic_block(self._t0)
+        if self.alert_engine is not None:
+            # Armed-rule states for /status and the per-rule
+            # tffm_alert_active gauges.
+            rec["alerts"] = self.alert_engine.active_snapshot()
         if self._tracer.enabled:
             rec["trace_dropped_events"] = self._tracer.dropped_events
         return rec
@@ -1389,7 +1418,8 @@ class FleetHandle:
         self.router.close()
         if self.manager is not None:
             self.manager.close()
-        if self._writer is not None:
+        blackbox = self.router.blackbox
+        if self._writer is not None or blackbox is not None:
             try:
                 final = self.router._build("final")
                 if self.exception is not None:
@@ -1398,9 +1428,21 @@ class FleetHandle:
                     # stopped, same contract as the trainer's.
                     final["exception"] = type(self.exception).__name__
                     final["exception_msg"] = str(self.exception)
-                self._writer.write(final)
+                if self._writer is not None:
+                    self._writer.write(final)
+                if blackbox is not None:
+                    blackbox.observe_record(final)
             except Exception as e:  # noqa: BLE001 - teardown best-effort
                 log.warning("router final record write failed: %s", e)
+        # Crash-truthful bundle, dumped BEFORE the writer closes so
+        # the incident manifest still reaches the metrics stream.
+        if (
+            blackbox is not None
+            and self.exception is not None
+            and not isinstance(self.exception, KeyboardInterrupt)
+        ):
+            blackbox.incident("crash_" + type(self.exception).__name__)
+        if self._writer is not None:
             self._writer.close()
         if self._tracer is not None and self._tracer.enabled:
             try:
@@ -1444,15 +1486,7 @@ def start_fleet(cfg: FmConfig, cfg_path: str,
     manager = None
     router = None
     heartbeat = None
-    # Alert watchdog on the ROUTER's heartbeat: the serve-signal rules
-    # (shed_frac, burn_rate, evictions, fleet_scrape_age_max_s, ...)
-    # evaluate against every fleet heartbeat; action=halt arms the
-    # engine and serve_fleet stops the fleet (crash-truthful final).
     alert_engine = None
-    if cfg.alert_rules:
-        alert_engine = obs.AlertEngine(
-            obs.parse_rules(cfg.alert_rules), writer=writer
-        )
     try:
         manager = ReplicaManager(cfg, cfg_path, overrides=overrides)
         router = ServeRouter(
@@ -1461,8 +1495,7 @@ def start_fleet(cfg: FmConfig, cfg_path: str,
             host=cfg.serve_host, manifest_seen=manifest_seen,
             tracer=tracer, respawner=manager.respawn,
         )
-        if writer is not None:
-            writer.write({
+        run_header = {
                 "record": "run_header",
                 "mode": "serve_router",
                 "time": time.time(),
@@ -1490,12 +1523,52 @@ def start_fleet(cfg: FmConfig, cfg_path: str,
                 "alert_rules": cfg.alert_rules,
                 "trace_file": cfg.trace_file,
                 "replica_ports": [r.port for r in manager.replicas],
-            })
+                "blackbox": cfg.blackbox,
+        }
+        if writer is not None:
+            writer.write(run_header)
+        # The router's incident flight recorder: its rings hold the
+        # fleet-level heartbeats (per-replica scrape detail included),
+        # so an alert bundle names the unhealthy replica without any
+        # replica-side digging.
+        if cfg.blackbox:
+            router.blackbox = obs.Blackbox(
+                cfg.incident_dir
+                or os.path.join(cfg.model_file, "incidents"),
+                suffix="router",
+                run_header=run_header,
+                metrics_render=router._render_metrics,
+                trace_tail_fn=(
+                    tracer.tail if tracer.enabled else None
+                ),
+                writer=writer,
+                telemetry=telemetry,
+            )
+        # Alert watchdog on the ROUTER's heartbeat: the serve-signal
+        # rules (shed_frac, burn_rate, evictions,
+        # fleet_scrape_age_max_s, ...) evaluate against every fleet
+        # heartbeat; action=halt arms the engine and serve_fleet stops
+        # the fleet (crash-truthful final).  Breaches also reach the
+        # blackbox, which dumps a forensic bundle.
+        if cfg.alert_rules:
+            alert_engine = obs.AlertEngine(
+                obs.parse_rules(cfg.alert_rules), writer=writer,
+                on_alert=(
+                    router.blackbox.on_alert
+                    if router.blackbox is not None else None
+                ),
+            )
+            router.alert_engine = alert_engine
 
         def heartbeat_build():
             rec = router._build("heartbeat")
-            if rec is not None and alert_engine is not None:
-                alert_engine.observe(rec)
+            if rec is not None:
+                # Ring BEFORE the alert engine observes, so an alert-
+                # triggered bundle contains the breaching record.
+                if router.blackbox is not None:
+                    router.blackbox.observe_record(rec)
+                if alert_engine is not None:
+                    alert_engine.observe(rec)
             return rec
 
         if cfg.heartbeat_secs > 0:

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import signal
 import threading
 import time
@@ -196,7 +197,7 @@ class ServeServer:
                  build, telemetry=None, host: str = "127.0.0.1",
                  timeout_s: float = 30.0, scorer=None, tracer=None,
                  sampler=None, slo=None, on_reload=None,
-                 on_rollback=None):
+                 on_rollback=None, capture=None, incident=None):
         tel = telemetry if telemetry is not None else obs.NULL
         tracer = tracer if tracer is not None else NULL_TRACER
         # Request-id mint + trace-sampling coin flip for DIRECT
@@ -251,6 +252,24 @@ class ServeServer:
                 ctype, body = encode(np.zeros((0,), np.float32))
                 handler._send(200, body, ctype, headers=rid_hdr)
                 return
+            # Traffic capture (serve_capture_sample): the request frame
+            # is encoded NOW, while this handler still owns the arrays
+            # — the batcher releases pooled text-parse scratch the
+            # moment its dispatcher stops reading it.  Canonical form
+            # (post-pad, post-modulo) makes re-decoding idempotent, so
+            # replaying the frame reproduces the response bitwise.
+            # Unsampled requests pay one attribute compare.
+            cap_req = None
+            if capture is not None and capture.sample():
+                try:
+                    cap_req = wire.encode_bin_request(
+                        ids[:n], vals[:n],
+                        fields[:n]
+                        if (cfg.field_num and fields is not None)
+                        else None,
+                    )
+                except Exception as e:  # noqa: BLE001 - forensics only
+                    log.warning("capture encode failed: %s", e)
             try:
                 scores = batcher.score(
                     ids, vals,
@@ -270,6 +289,8 @@ class ServeServer:
                     "text/plain", headers=rid_hdr,
                 )
                 return
+            if cap_req is not None:
+                capture.write(cap_req, encode_bin_response(scores))
             t_r0 = time.perf_counter()
             ctype, body = encode(scores)
             handler._send(200, body, ctype, headers=rid_hdr)
@@ -297,6 +318,9 @@ class ServeServer:
                 path, _, query = self.path.partition("?")
                 if path in ("/reload", "/promote", "/rollback"):
                     self._do_admin(path, query)
+                    return
+                if path == "/incident":
+                    self._post_incident(query, incident)
                     return
                 if path not in ("/score", "/score_bin"):
                     self._send(404, b"not found\n", "text/plain")
@@ -474,7 +498,7 @@ class ServeHandle:
 
     def __init__(self, cfg, scorer, batcher, server, watcher, telemetry,
                  writer, heartbeat, build, tracer=None,
-                 alert_engine=None):
+                 alert_engine=None, blackbox=None, capture=None):
         self.cfg = cfg
         self.scorer = scorer
         self.batcher = batcher
@@ -483,6 +507,8 @@ class ServeHandle:
         self.telemetry = telemetry
         self.port = server.port
         self.alert_engine = alert_engine
+        self.blackbox = blackbox
+        self.capture = capture
         self.exception: Optional[BaseException] = None
         self._writer = writer
         self._heartbeat = heartbeat
@@ -500,7 +526,7 @@ class ServeHandle:
         self.batcher.close()
         if self._heartbeat is not None:
             self._heartbeat.close()
-        if self._writer is not None:
+        if self._writer is not None or self.blackbox is not None:
             try:
                 final = self._build("final")
                 if final is not None:
@@ -511,9 +537,27 @@ class ServeHandle:
                             self.exception
                         ).__name__
                         final["exception_msg"] = str(self.exception)
-                    self._writer.write(final)
+                    if self._writer is not None:
+                        self._writer.write(final)
+                    if self.blackbox is not None:
+                        self.blackbox.observe_record(final)
             except Exception as e:  # noqa: BLE001 - teardown best-effort
                 log.warning("serve final record write failed: %s", e)
+        # Crash-truthful bundle: an AlertHaltError (or any crash) that
+        # tears serving down leaves its forensics behind.  Dumped
+        # BEFORE the writer closes so the manifest still reaches the
+        # metrics stream; a clean close dumps nothing.
+        if (
+            self.blackbox is not None
+            and self.exception is not None
+            and not isinstance(self.exception, KeyboardInterrupt)
+        ):
+            self.blackbox.incident(
+                "crash_" + type(self.exception).__name__
+            )
+        if self.capture is not None:
+            self.capture.close()
+        if self._writer is not None:
             self._writer.close()
         if self._tracer is not None and self._tracer.enabled:
             try:
@@ -697,6 +741,16 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
         queue_size=cfg.queue_size, telemetry=telemetry, tracer=tracer,
         slo=slo, quality=skew,
     )
+    # Live-traffic capture (serve_capture_sample/serve_capture_file):
+    # sampled request/response frame pairs land in a rotating TFC1
+    # file for tools/replay.py.  FmConfig guarantees both knobs are set
+    # together; unset = None = byte-identical serving (pinned by test).
+    capture = None
+    if cfg.serve_capture_file:
+        capture = wire.CaptureWriter(
+            cfg.serve_capture_file, sample=cfg.serve_capture_sample,
+            telemetry=telemetry,
+        )
     t0 = time.time()
 
     def build(kind: str = "status"):
@@ -722,14 +776,20 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             "serve": serve_block,
             "stages": snap,
         }
+        if cfg.resource_metrics:
+            rec["resource"] = obs.basic_block(t0)
+        if alert_engine is not None:
+            # Armed-rule states for /status and the per-rule
+            # tffm_alert_active gauges (defined below build; every
+            # call happens after serve() finishes wiring).
+            rec["alerts"] = alert_engine.active_snapshot()
         if tracer.enabled:
             rec["trace_dropped_events"] = tracer.dropped_events
             if cfg.trace_rotate_events:
                 rec["trace_windows"] = tracer.windows_written
         return rec
 
-    if writer is not None:
-        writer.write({
+    run_header = {
             "record": "run_header",
             "mode": "serve",
             "time": t0,
@@ -752,22 +812,57 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
             "kernel_impl": getattr(scorer, "kernel_impl", "reference"),
             "interaction_impl": cfg.interaction_impl,
             "compile_cache_dir": cfg.compile_cache_dir,
-        })
+            "serve_capture_sample": cfg.serve_capture_sample,
+            "blackbox": cfg.blackbox,
+    }
+    if writer is not None:
+        writer.write(run_header)
+    # Incident flight recorder: fixed-memory rings of recent records/
+    # alerts feeding alert-triggered (and POST /incident) forensic
+    # bundles.  The pid suffix keeps co-hosted replicas sharing one
+    # incident_dir collision-free; blackbox=false = None = no rings,
+    # no routes, byte-identical serving.
+    blackbox = None
+    if cfg.blackbox:
+        blackbox = obs.Blackbox(
+            cfg.incident_dir
+            or os.path.join(cfg.model_file, "incidents"),
+            suffix=f"pid{os.getpid()}",
+            run_header=run_header,
+            metrics_render=lambda: obs.render_prometheus(
+                build("status")
+            ),
+            trace_tail_fn=(tracer.tail if tracer.enabled else None),
+            capture_tail_fn=(
+                capture.tail_bytes if capture is not None else None
+            ),
+            writer=writer,
+            telemetry=telemetry,
+        )
     # Alert watchdog riding the serve heartbeat (same contract as the
     # trainer's: FmConfig guarantees heartbeat_secs > 0 when rules are
     # set; breaches write `record: alert`; an action=halt rule arms
     # engine.halted, which serve_forever raises as AlertHaltError —
-    # an embedder polls handle.alert_engine itself).
+    # an embedder polls handle.alert_engine itself).  Every emitted
+    # alert also reaches the blackbox, which dumps a bundle.
     alert_engine = None
     if cfg.alert_rules:
         alert_engine = obs.AlertEngine(
-            obs.parse_rules(cfg.alert_rules), writer=writer
+            obs.parse_rules(cfg.alert_rules), writer=writer,
+            on_alert=(
+                blackbox.on_alert if blackbox is not None else None
+            ),
         )
 
     def heartbeat_build():
         rec = build("heartbeat")
-        if rec is not None and alert_engine is not None:
-            alert_engine.observe(rec)
+        if rec is not None:
+            # Ring BEFORE the alert engine observes: an alert-triggered
+            # bundle must contain the record that breached the rule.
+            if blackbox is not None:
+                blackbox.observe_record(rec)
+            if alert_engine is not None:
+                alert_engine.observe(rec)
         return rec
 
     heartbeat = None
@@ -800,6 +895,10 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
                 skew.restore_previous_reference
                 if skew is not None else None
             ),
+            capture=capture,
+            incident=(
+                blackbox.incident if blackbox is not None else None
+            ),
         )
     except BaseException:
         # A taken port (or watcher failure) must not leak the batcher
@@ -809,6 +908,8 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
         batcher.close()
         if heartbeat is not None:
             heartbeat.close()
+        if capture is not None:
+            capture.close()
         if writer is not None:
             writer.close()
         if tracer is not NULL_TRACER:
@@ -822,6 +923,7 @@ def serve(cfg: FmConfig, mesh=None, port: Optional[int] = None
     return ServeHandle(
         cfg, scorer, batcher, server, watcher, telemetry, writer,
         heartbeat, build, tracer=tracer, alert_engine=alert_engine,
+        blackbox=blackbox, capture=capture,
     )
 
 

@@ -1274,7 +1274,16 @@ class Trainer:
         out = {
             "rss_mb": round(rss / (1 << 20), 1),
             "peak_rss_mb": round(peak / (1 << 20), 1),
+            # Process vitals the incident/alert plane watches: run
+            # uptime (alert alias `uptime_s`) and the open-descriptor
+            # count from /proc/self/fd (alias `open_fds`) — a leaking
+            # fd ledger is the classic slow-burn incident.  The fd key
+            # is omitted where /proc is unavailable.
+            "uptime_s": round(wall, 3),
         }
+        fds = obs.read_open_fds()
+        if fds >= 0:
+            out["open_fds"] = fds
         if self.telemetry.enabled:
             # The owner-maintained gauges are no-op instruments when
             # telemetry is off — a hard 0 next to a real RSS would be
@@ -1319,6 +1328,17 @@ class Trainer:
             jax.profiler.stop_trace()
         log.info("on-demand profiler capture (%.1fs) written to %s",
                  secs, out)
+        writer = getattr(self, "_metrics_writer", None)
+        if writer is not None:
+            # The stream records that (and when) a capture perturbed
+            # the run — a profiler window shows up as a step-time blip
+            # that would otherwise read as a real regression.
+            writer.write({
+                "record": "profile",
+                "time": time.time(),
+                "secs": float(secs),
+                "profile_dir": out,
+            })
         return out
 
     def _reset_health(self) -> None:
@@ -1766,11 +1786,14 @@ class Trainer:
         if self._sentinel is not None:
             self._sentinel.reset()
             self._sentinel.set_writer(metrics_out)
-        # /profile captures land beside the metrics stream (or cwd).
+        # /profile captures land beside the metrics stream (or cwd);
+        # the writer is stashed so the route can log each capture as a
+        # `record: profile` entry in the same stream.
         self._profile_capture_dir = os.path.join(
             os.path.dirname(cfg.metrics_file) or ".",
             "tffm_profile_ondemand",
         )
+        self._metrics_writer = metrics_out
         # /metrics self-identification: one info-style gauge whose
         # labels name the run (tffm_build_info) so scrapes from
         # different runs/configs are distinguishable in Prometheus.
@@ -2053,27 +2076,64 @@ class Trainer:
                 # straggler_ratio / rank_step_skew / exchange_frac /
                 # scrape_age_max_s from this block.
                 rec["fleet"] = fleet.block(now)
+            if alert_engine is not None:
+                # Armed-rule states for /status and the per-rule
+                # tffm_alert_active gauges (the engine is created just
+                # below; every record is built after that).
+                rec["alerts"] = alert_engine.active_snapshot()
             return rec
 
+        # Incident flight recorder (obs/blackbox.py): fixed-memory
+        # rings of recent heartbeats/alerts; rule breaches, crashes,
+        # and POST /incident dump forensic bundles under
+        # <model_file>/incidents (incident_dir overrides).  The rank
+        # suffix keeps a fleet's bundles collision-free.
+        # blackbox=false = None = rings never touched, training
+        # bitwise-identical (pinned by test).
+        blackbox = None
+        if cfg.blackbox:
+            blackbox = obs.Blackbox(
+                cfg.incident_dir
+                or os.path.join(cfg.model_file, "incidents"),
+                suffix=f"rank{rank}",
+                run_header=dict(self._build_info),
+                metrics_render=lambda: obs.render_prometheus(
+                    telemetry_record("status")
+                ),
+                trace_tail_fn=(
+                    self.tracer.tail if self.tracer.enabled else None
+                ),
+                writer=metrics_out,
+                telemetry=self.telemetry,
+            )
         # Alert watchdog: declarative rules evaluated against every
         # heartbeat record ON the heartbeat thread (obs/alerts.py).
         # Breaches emit `record: alert` JSONL entries; an action=halt
         # rule arms engine.halted and the DISPATCH loop below raises
         # AlertHaltError at the next boundary (same no-poisoned-
-        # checkpoint contract as nan_policy=halt).
+        # checkpoint contract as nan_policy=halt).  Every emitted
+        # alert also reaches the blackbox, which dumps a bundle.
         alert_engine = None
         if cfg.alert_rules:
             # FmConfig already guarantees heartbeat_secs > 0 whenever
             # rules are set (a watchdog with no heartbeat to ride
             # would be silently inert).
             alert_engine = obs.AlertEngine(
-                obs.parse_rules(cfg.alert_rules), writer=metrics_out
+                obs.parse_rules(cfg.alert_rules), writer=metrics_out,
+                on_alert=(
+                    blackbox.on_alert if blackbox is not None else None
+                ),
             )
 
         def heartbeat_build():
             rec = telemetry_record("heartbeat")
-            if rec is not None and alert_engine is not None:
-                alert_engine.observe(rec)
+            if rec is not None:
+                # Ring BEFORE the alert engine observes, so an alert-
+                # triggered bundle contains the breaching record.
+                if blackbox is not None:
+                    blackbox.observe_record(rec)
+                if alert_engine is not None:
+                    alert_engine.observe(rec)
             return rec
 
         heartbeat = None
@@ -2095,6 +2155,10 @@ class Trainer:
                     cfg.status_port, partial(telemetry_record, "status"),
                     telemetry=self.telemetry, host=cfg.status_host,
                     profile=self._ondemand_profile,
+                    incident=(
+                        blackbox.incident if blackbox is not None
+                        else None
+                    ),
                     # Rank 0 of a fleet decorates /metrics with the
                     # per-rank tffm_train_rank_* labeled series.
                     metrics_extra=(
@@ -2459,6 +2523,18 @@ class Trainer:
             if run_exc is not None:
                 self._final_record["exception"] = type(run_exc).__name__
                 self._final_record["exception_msg"] = str(run_exc)[:300]
+            if blackbox is not None:
+                blackbox.observe_record(self._final_record)
+                if run_exc is not None and not isinstance(
+                    run_exc, KeyboardInterrupt
+                ):
+                    # Crash-truthful bundle (NonFiniteGradError,
+                    # AlertHaltError, anything unhandled): dumped
+                    # before the writer closes so the incident
+                    # manifest still reaches the metrics stream.
+                    blackbox.incident(
+                        "crash_" + type(run_exc).__name__
+                    )
             if metrics_out is not None:
                 try:
                     metrics_out.write(self._final_record)

@@ -63,6 +63,26 @@ tracing sampled at 1.0 (``--trace`` + ``--serve_trace_sample 1``):
     queue -> coalesce -> dispatch -> respond), the SIGKILLed
     replica's lost spans notwithstanding.
 
+Then the INCIDENT smoke (flight recorder + capture/replay, ISSUE 20) —
+``run_tffm.py serve`` with an always-breaching alert rule, full-sample
+traffic capture, and an explicit ``--incident_dir``:
+
+15. the breach dumps a VALID forensic bundle (manifest naming the
+    rule, heartbeat ring with the ``alerts`` block, threadz dump, a
+    /metrics snapshot carrying ``tffm_alert_active{rule=...}`` — also
+    asserted on the LIVE endpoint), its dir name pid-suffixed;
+    ``POST /incident?reason=...`` dumps a second, manually-named
+    bundle and answers its dir as JSON;
+16. ``tools/report.py --incident <bundle>`` renders the summary
+    (rule fired, signal trajectory) and exits 0;
+17. the TFC1 capture file replays BITWISE against a fresh serve
+    subprocess on the same checkpoint (``tools/replay.py`` exit 0) —
+    the capture/replay loop closes end to end.
+
+The training stage also asserts the ``record: profile`` entry the
+``/profile`` capture writes, and the resource block's
+``uptime_s``/``open_fds`` vitals.
+
 Exit 0 = all held; any other exit fails the audit.
 """
 
@@ -495,6 +515,225 @@ def check_serve(cfg_path: str, data: str) -> None:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
+
+
+def _wait_healthz(base: str, proc, what: str,
+                  timeout_s: float = 120.0) -> None:
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            urllib.request.urlopen(f"{base}/healthz", timeout=2)
+            return
+        except (urllib.error.URLError, OSError) as e:
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                sys.stderr.write(out.decode(errors="replace")[-2000:])
+                raise SystemExit(
+                    f"FAIL: {what} exited {proc.returncode} before "
+                    f"answering ({e})"
+                )
+            if time.time() > deadline:
+                raise SystemExit(f"FAIL: {what} unreachable ({e})")
+            time.sleep(0.2)
+
+
+def check_incident(cfg_path: str, data: str) -> None:
+    """Incident flight recorder + traffic capture, end to end (ISSUE
+    20): a real serve subprocess with an always-breaching alert rule
+    and full-sample capture; asserts
+
+    a. the breach dumps a VALID forensic bundle (manifest + rings +
+       threadz + metrics snapshot), its dir name carrying the pid
+       suffix and an ``alert_`` reason;
+    b. ``POST /incident?reason=...`` dumps a second, manually-named
+       bundle and answers its dir as JSON;
+    c. ``tools/report.py --incident`` renders the bundle (rule fired,
+       signal trajectory) and exits 0;
+    d. the capture file replays against a FRESH server on the same
+       checkpoint with bitwise score parity (``tools/replay.py``
+       exit 0).
+    """
+    tmpdir = os.path.dirname(cfg_path)
+    incident_dir = os.path.join(tmpdir, "incidents")
+    capture_file = os.path.join(tmpdir, "requests.capture")
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "run_tffm.py"), "serve",
+         cfg_path, "--serve_port", str(port),
+         "--serve_poll_secs", "0",
+         # uptime_s is alive from the first heartbeat, so this rule
+         # breaches ~0.2 s in — the injected incident.
+         "--alert_rules", "uptime_s > 0 : warn",
+         "--incident_dir", incident_dir,
+         "--serve_capture_sample", "1",
+         "--serve_capture_file", capture_file],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        _wait_healthz(base, proc, "incident-smoke serve")
+        # Traffic for the capture file (sample 1.0 records every one).
+        with open(data) as f:
+            lines = "".join(f.readline() for _ in range(10))
+        for _ in range(3):
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/score", data=lines.encode(), method="POST"
+            ), timeout=30).read()
+        # (a) the breach-triggered bundle.
+        deadline = time.time() + 60
+        bundle = None
+        while time.time() < deadline:
+            if os.path.isdir(incident_dir):
+                for name in sorted(os.listdir(incident_dir)):
+                    man = os.path.join(
+                        incident_dir, name, "manifest.json"
+                    )
+                    if "alert_" in name and os.path.exists(man):
+                        bundle = os.path.join(incident_dir, name)
+                        break
+            if bundle:
+                break
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                sys.stderr.write(out.decode(errors="replace")[-2000:])
+                raise SystemExit(
+                    f"FAIL: serve exited {proc.returncode} before "
+                    f"dumping the alert bundle"
+                )
+            time.sleep(0.1)
+        if bundle is None:
+            raise SystemExit(
+                f"FAIL: alert breach dumped no incident bundle under "
+                f"{incident_dir}"
+            )
+        if "_pid" not in os.path.basename(bundle):
+            raise SystemExit(
+                f"FAIL: bundle dir carries no pid suffix: {bundle}"
+            )
+        with open(os.path.join(bundle, "manifest.json")) as f:
+            manifest = json.load(f)
+        if not manifest.get("reason", "").startswith("alert_"):
+            raise SystemExit(
+                f"FAIL: manifest reason {manifest.get('reason')!r} "
+                f"does not name the breached rule"
+            )
+        records = [
+            json.loads(line)
+            for line in open(os.path.join(bundle, "records.jsonl"))
+        ]
+        if not records or records[-1].get("record") != "heartbeat":
+            raise SystemExit(
+                f"FAIL: bundle records ring empty or malformed "
+                f"({len(records)} records)"
+            )
+        if (records[-1].get("alerts") or {}).get("armed") != 1:
+            raise SystemExit(
+                "FAIL: ringed record carries no alerts block: "
+                f"{records[-1].get('alerts')}"
+            )
+        with open(os.path.join(bundle, "threadz.txt")) as f:
+            threadz = f.read()
+        if "--- thread" not in threadz:
+            raise SystemExit("FAIL: bundle threadz.txt is not a dump")
+        with open(os.path.join(bundle, "metrics.prom")) as f:
+            prom = f.read()
+        if "tffm_alert_active" not in prom:
+            raise SystemExit(
+                "FAIL: bundle metrics snapshot lacks the per-rule "
+                "tffm_alert_active gauge"
+            )
+        # Live /metrics must carry the armed-rule gauge too.
+        live = urllib.request.urlopen(
+            f"{base}/metrics", timeout=10).read().decode()
+        if 'tffm_alert_active{rule="' not in live:
+            raise SystemExit(
+                "FAIL: live /metrics lacks tffm_alert_active{rule=...}"
+            )
+        # (b) the manual POST /incident route.
+        resp = urllib.request.urlopen(urllib.request.Request(
+            f"{base}/incident?reason=smoke", data=b"", method="POST"
+        ), timeout=30)
+        doc = json.loads(resp.read())
+        manual = doc.get("incident_dir")
+        if not manual or not os.path.exists(
+            os.path.join(manual, "manifest.json")
+        ):
+            raise SystemExit(
+                f"FAIL: POST /incident answered no valid bundle: {doc}"
+            )
+        if "smoke" not in os.path.basename(manual):
+            raise SystemExit(
+                f"FAIL: manual bundle ignores ?reason=smoke: {manual}"
+            )
+        # (c) report.py renders the alert bundle.
+        rep = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "report.py"),
+             "--incident", bundle],
+            cwd=REPO, capture_output=True, timeout=60,
+        )
+        rep_out = rep.stdout.decode(errors="replace")
+        if rep.returncode != 0 or "incident:" not in rep_out \
+                or "uptime_s" not in rep_out:
+            sys.stderr.write(rep_out[-2000:])
+            raise SystemExit(
+                f"FAIL: report.py --incident exited {rep.returncode} "
+                f"or named no rule"
+            )
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+    # (d) capture -> replay, bitwise, against a fresh server on the
+    # same checkpoint (capture off — the replay target must not
+    # append to the file it is being judged against).
+    if not os.path.exists(capture_file):
+        raise SystemExit(f"FAIL: no capture file at {capture_file}")
+    r_port = _free_port()
+    r_proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "run_tffm.py"), "serve",
+         cfg_path, "--serve_port", str(r_port),
+         "--serve_poll_secs", "0"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        _wait_healthz(f"http://127.0.0.1:{r_port}", r_proc,
+                      "replay-target serve")
+        rep = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "replay.py"),
+             capture_file, "--endpoint",
+             f"http://127.0.0.1:{r_port}"],
+            cwd=REPO, capture_output=True, timeout=120,
+        )
+        rep_out = rep.stdout.decode(errors="replace")
+        if rep.returncode != 0:
+            sys.stderr.write(rep_out[-2000:])
+            sys.stderr.write(rep.stderr.decode(errors="replace")[-500:])
+            raise SystemExit(
+                f"FAIL: tools/replay.py exited {rep.returncode} — "
+                f"captured traffic did not re-score bitwise"
+            )
+        n_match = rep_out.split("/")[0].rsplit(" ", 1)[-1]
+        print(
+            f"incident smoke ok: alert bundle {os.path.basename(bundle)}"
+            f" valid, POST /incident dumped "
+            f"{os.path.basename(manual)}, report.py rendered it, "
+            f"replay re-scored {n_match} captured request(s) bitwise"
+        )
+    finally:
+        if r_proc.poll() is None:
+            r_proc.terminate()
+            try:
+                r_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                r_proc.kill()
+                r_proc.wait()
 
 
 def check_router(cfg_path: str, data: str) -> None:
@@ -1065,6 +1304,22 @@ max_features = 4
                 f"FAIL: final record's quality block is missing or "
                 f"empty: {q}"
             )
+        # The /profile capture above must have logged itself into the
+        # stream (`record: profile`) — a profiler window perturbs step
+        # time, and the stream has to say so.
+        profiles = [r for r in finals if r.get("record") == "profile"]
+        if not profiles or not profiles[-1].get("profile_dir"):
+            raise SystemExit(
+                f"FAIL: /profile capture wrote no `record: profile` "
+                f"entry to the metrics stream ({len(profiles)} found)"
+            )
+        # Resource vitals (ISSUE 20): uptime + the open-fd ledger must
+        # ride the resource block.
+        res = final.get("resource") or {}
+        if res.get("uptime_s", 0) <= 0 or "open_fds" not in res:
+            raise SystemExit(
+                f"FAIL: resource block lacks uptime_s/open_fds: {res}"
+            )
         print(
             f"obs smoke ok: /status step={status['step']}, /metrics "
             f"served {n} Prometheus samples, quality block eval'd "
@@ -1078,6 +1333,11 @@ max_features = 4
     # saved (run_tffm.py serve in its own subprocess), then the router
     # smoke mounts a 2-replica fleet over the same checkpoint.
     check_serve(cfg_path, data)
+    # Incident flight recorder + capture/replay (ISSUE 20): an
+    # injected alert breach must dump a valid forensic bundle,
+    # report.py must render it, and the captured traffic must replay
+    # bitwise against a fresh server on the same checkpoint.
+    check_incident(cfg_path, data)
     check_router(cfg_path, data)
     # Fleet-training smoke (ISSUE 18): 2 spawned CPU ranks, rank 0
     # aggregating, an injected straggler tripping the live alert.

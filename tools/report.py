@@ -47,6 +47,13 @@ Three modes (see OBSERVABILITY.md):
    alerting is itself a regression.  Exit code 2 when any regression is
    flagged, so the BENCH trajectory check stops being eyeball-only.
 
+4. ``--incident DIR``: human summary of one blackbox forensic bundle
+   (``incidents/<ts>_<reason>/``, see OBSERVABILITY.md "Incidents &
+   capture"): which rule fired (or what crashed), the breached
+   signals' trajectory across the ringed records, the critical path
+   from the trace tail, and slowest-rank / slowest-replica
+   attribution from the last ringed record.
+
 Dependency-free on purpose: it must run on any box the artifacts land
 on, jax or not.
 """
@@ -59,6 +66,7 @@ import json
 import os
 import re
 import sys
+import time
 
 
 def _classify(rec: dict) -> str:
@@ -1172,6 +1180,16 @@ _DIRECTION_OVERRIDES = {
     # key whose trend already crossed its threshold counts here — a
     # new one appearing is itself a regression signal.
     "timeline_regressions": "low",
+    # Incident flight recorder (ISSUE 20): the traffic-capture cost
+    # ratio (off/on qps, same paired shape as the trace/quality/fleet
+    # probes) regresses when it RISES past the 1.05 budget; how many
+    # requests the capture window recorded and how many bundles a run
+    # dumped are informational (a run that ALERTS more already flags
+    # via alerts_total).
+    "capture_overhead": "low",
+    "capture_requests": None,
+    "serve.capture_requests": None,
+    "obs.incidents": None,
 }
 
 
@@ -1502,6 +1520,162 @@ def timeline_mode(paths: list, thresholds: dict) -> int:
     return 0
 
 
+def _dig_numeric(rec: dict, dotted: str):
+    """Resolve a dotted signal path (``serve.qps``) against one
+    record; bare spellings fall back to the standard blocks the alert
+    aliases resolve into.  Returns a float or None."""
+
+    def walk(cur, parts):
+        for part in parts:
+            if not isinstance(cur, dict) or part not in cur:
+                return None
+            cur = cur[part]
+        return cur
+
+    val = walk(rec, dotted.split("."))
+    if val is None and "." not in dotted:
+        for block in ("resource", "serve", "health", "fleet",
+                      "tiered", "quality"):
+            val = walk(rec, [block, dotted])
+            if val is not None:
+                break
+    if isinstance(val, bool) or not isinstance(val, (int, float)):
+        return None
+    return float(val)
+
+
+def incident_mode(path: str, limit: int = 8) -> int:
+    """Render one blackbox bundle (``incidents/<ts>_<reason>/``) as a
+    human incident summary.  Informational: exits 1 only when the
+    manifest itself is unreadable."""
+    man_path = os.path.join(path, "manifest.json")
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"{man_path}: unreadable incident manifest ({e})")
+        return 1
+
+    def _jsonl(name: str) -> list:
+        rows = []
+        try:
+            with open(os.path.join(path, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            rows.append(json.loads(line))
+                        except ValueError:
+                            pass
+        except OSError:
+            pass
+        return rows
+
+    records = _jsonl("records.jsonl")
+    alerts = _jsonl("alerts.jsonl")
+    when = manifest.get("time")
+    stamp = (
+        time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime(when))
+        if isinstance(when, (int, float)) else "?"
+    )
+    landed = sorted(
+        name for name, ok in (manifest.get("files") or {}).items() if ok
+    )
+    print(f"incident: {manifest.get('reason', '?')}  ({stamp})")
+    print(f"  bundle:  {path}")
+    print(f"  process: {manifest.get('suffix') or '-'}")
+    print(f"  rings:   {len(records)} record(s), {len(alerts)} "
+          f"alert(s); artifacts: {', '.join(landed) or 'none'}")
+
+    if alerts:
+        print(f"\nalerts (last {min(len(alerts), limit)} of "
+              f"{len(alerts)}):")
+        for a in alerts[-limit:]:
+            print(
+                f"  {a.get('rule', '?'):30} action={a.get('action', '?')}"
+                f"  value={a.get('value', '?')} (threshold "
+                f"{a.get('op', '?')} {a.get('threshold', '?')}, "
+                f"step {a.get('step', '?')})"
+            )
+
+    # Signal trajectory: the breached signals first, then the standard
+    # page-one vitals, each sparklined across the ringed records.
+    signals = []
+    for a in alerts:
+        sig = a.get("signal")
+        if sig and sig not in signals:
+            signals.append(sig)
+    for sig in ("serve.qps", "serve.p99_ms", "ingest_wait_frac",
+                "resource.rss_mb", "resource.open_fds", "step"):
+        if sig not in signals:
+            signals.append(sig)
+    rows = []
+    for sig in signals:
+        vals = [v for v in (_dig_numeric(r, sig) for r in records)
+                if v is not None]
+        if len(vals) >= 2:
+            rows.append((sig, vals))
+    if rows:
+        print("\nsignal trajectory (oldest -> newest):")
+        for sig, vals in rows:
+            print(f"  {sig:28} {_sparkline(vals)}  "
+                  f"{vals[0]:.4g} -> {vals[-1]:.4g}")
+
+    # Critical path from the trace-buffer tail: the longest complete
+    # spans right before the dump.
+    trace_path = os.path.join(path, "trace_tail.json")
+    if os.path.exists(trace_path):
+        try:
+            with open(trace_path) as f:
+                events = (json.load(f) or {}).get("traceEvents") or []
+        except (OSError, ValueError):
+            events = []
+        spans = [
+            e for e in events
+            if isinstance(e, dict) and e.get("ph") == "X"
+            and isinstance(e.get("dur"), (int, float))
+        ]
+        spans.sort(key=lambda e: e["dur"], reverse=True)
+        if spans:
+            print(f"\ntrace tail critical path (top "
+                  f"{min(len(spans), limit)} of {len(spans)} spans):")
+            for e in spans[:limit]:
+                print(f"  {e.get('name', '?'):32} "
+                      f"{e['dur'] / 1e3:10.3f} ms")
+
+    # Who was slowest when the incident fired: the trainer's fleet
+    # block or the router's per-replica scrape detail, whichever the
+    # last ringed record carries.
+    last = records[-1] if records else {}
+    fleet = last.get("fleet")
+    if isinstance(fleet, dict) and fleet:
+        keys = [k for k in ("slowest_rank", "slowest_rank_share",
+                            "straggler_ratio", "rank_step_skew",
+                            "dispatch_skew_ms", "wait_skew_ms",
+                            "ranks_scraped") if k in fleet]
+        if keys:
+            print("\nfleet attribution (last record):")
+            for k in keys:
+                print(f"  {k:24} {fleet[k]}")
+    per = (last.get("serve") or {}).get("per_replica")
+    if isinstance(per, list) and per:
+        slowest = max(
+            (p for p in per if isinstance(p.get("p99_ms"), (int, float))),
+            key=lambda p: p["p99_ms"], default=None,
+        )
+        print("\nreplica attribution (last record):")
+        for p in per:
+            mark = (" <- slowest" if slowest is not None
+                    and p is slowest else "")
+            print(
+                f"  replica {p.get('index', '?')}: "
+                f"healthy={p.get('healthy', '?')} "
+                f"inflight={p.get('inflight', '?')} "
+                f"p99_ms={p.get('p99_ms', 'n/a')}{mark}"
+            )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="summarize fast_tffm_tpu metrics JSONLs, merge "
@@ -1536,6 +1710,12 @@ def main(argv=None) -> int:
                          "(BENCH_r*.json): per-key sparkline + "
                          "first-regression attribution using the "
                          "--compare direction vocabulary")
+    ap.add_argument("--incident", action="store_true",
+                    help="treat the single path as a blackbox incident "
+                         "bundle dir (incidents/<ts>_<reason>/): print "
+                         "the rule fired, signal trajectories, the "
+                         "trace-tail critical path, and slowest rank/"
+                         "replica attribution")
     ap.add_argument("--threshold", action="append", default=None,
                     metavar="FLOAT|KEY=FLOAT",
                     help="--compare: regression flag threshold "
@@ -1544,6 +1724,10 @@ def main(argv=None) -> int:
                          "ingest_wait_frac=0.10 --threshold "
                          "default=0.05")
     args = ap.parse_args(argv)
+    if args.incident:
+        if len(args.paths) != 1:
+            ap.error("--incident takes exactly one bundle directory")
+        return incident_mode(args.paths[0], args.limit)
     if args.serve_trace:
         return serve_trace_mode(args.paths, args.out, args.limit)
     if args.trace:

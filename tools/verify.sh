@@ -54,7 +54,10 @@ echo "== live observability + serving smoke (tools/obs_smoke.py) =="
 # Then the serve smoke against the checkpoint that run wrote:
 # run_tffm.py serve must score over the socket, expose tffm_serve_*
 # on /metrics, and hot-swap once when a second training run
-# republishes the checkpoint manifest.
+# republishes the checkpoint manifest.  Then the incident smoke: an
+# injected alert breach must dump a valid blackbox bundle,
+# report.py --incident must render it, and the TFC1 traffic capture
+# must replay bitwise against a fresh server (tools/replay.py).
 JAX_PLATFORMS=cpu python tools/obs_smoke.py || exit 1
 
 echo
